@@ -1,0 +1,25 @@
+"""foundationdb_tpu — a TPU-native transactional key-value framework.
+
+A from-scratch re-imagining of FoundationDB (reference surveyed in SURVEY.md):
+an ordered, distributed KV store with strictly serializable ACID transactions
+via optimistic concurrency control. The commit-time conflict resolver — the
+reference's CPU skip-list sweep (fdbserver/SkipList.cpp) — is re-designed as a
+batched interval-overlap kernel under JAX (jit/vmap) on TPU, resolving
+64K–1M transaction batches per device step. Around the kernel: a deterministic
+simulation-first runtime (flow/ equivalent), a versioned commit pipeline,
+MVCC storage, and multi-resolver sharding over a jax device mesh.
+
+Layer map (mirrors reference layers, TPU-first mechanisms):
+  core/      — deterministic cooperative runtime: futures, virtual-time event
+               loop, seeded randomness, trace events, knobs (ref: flow/)
+  ops/       — JAX/TPU data-plane kernels: key encoding, conflict detection
+               (ref: fdbserver/SkipList.cpp, ConflictSet.h)
+  parallel/  — device-mesh sharding: multi-resolver key-space partition
+               (ref: resolver partitioning, MasterProxyServer.actor.cpp:233)
+  cluster/   — roles: sequencer, proxy, resolver, tlog, storage, recovery
+               (ref: fdbserver/)
+  client/    — transaction API: GRV, reads, RYW, commit, retry loop
+               (ref: fdbclient/NativeAPI.actor.cpp, ReadYourWrites.actor.cpp)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,103 @@
+"""Tunable knobs (ref: flow/Knobs.h, fdbserver/Knobs.cpp).
+
+A typed name->value registry settable at startup (--knob_NAME style) and
+randomizable under simulation. Values below carry the reference's defaults
+where the semantic is shared (file:line cited inline).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+class Knobs:
+    """Attribute access + registry. Subclasses declare defaults in initialize()."""
+
+    def __init__(self, randomize: bool = False, random=None):
+        self._registry: dict[str, Any] = {}
+        self._randomize = randomize
+        self._random = random
+        self.initialize(randomize, random)
+
+    def initialize(self, randomize: bool, random) -> None:  # pragma: no cover - overridden
+        pass
+
+    def init(self, name: str, value: Any, sim_random_range: tuple | None = None) -> Any:
+        """Register a knob. `sim_random_range=(lo, hi)` opts the knob into
+        randomization under simulation (ref: BUGGIFY_WITH_PROB'd knobs)."""
+        self._registry[name] = type(value)
+        if sim_random_range is not None and self._randomize and self._random is not None:
+            lo, hi = sim_random_range
+            if isinstance(value, int):
+                value = self._random.random_int(lo, hi + 1)
+            else:
+                value = lo + self._random.random01() * (hi - lo)
+        setattr(self, name, value)
+        return value
+
+    def set_knob(self, name: str, value: str) -> None:
+        name = name.upper()
+        if name not in self._registry:
+            raise KeyError(f"unknown knob {name}")
+        ty = self._registry[name]
+        if ty is bool:
+            setattr(self, name, value.lower() in ("1", "true", "yes"))
+        elif ty is tuple:
+            setattr(self, name, tuple(int(x) for x in value.split(",") if x))
+        else:
+            setattr(self, name, ty(value))
+
+    def all(self) -> dict[str, Any]:
+        return {k: getattr(self, k) for k in self._registry}
+
+
+class ServerKnobs(Knobs):
+    def initialize(self, randomize: bool, random) -> None:
+        init = self.init
+        # Versions (ref: fdbserver/Knobs.cpp:59-61)
+        init("VERSIONS_PER_SECOND", 1_000_000)
+        init("MAX_READ_TRANSACTION_LIFE_VERSIONS", 5 * 1_000_000)
+        init("MAX_WRITE_TRANSACTION_LIFE_VERSIONS", 5 * 1_000_000)
+        init("MAX_VERSIONS_IN_FLIGHT", 100 * 1_000_000)
+        # Commit batching (ref: fdbserver/Knobs.cpp:221-223)
+        init("COMMIT_TRANSACTION_BATCH_INTERVAL_MIN", 0.0005, sim_random_range=(0.0005, 0.005))
+        init("COMMIT_TRANSACTION_BATCH_INTERVAL_MAX", 0.020)
+        init("COMMIT_TRANSACTION_BATCH_COUNT_MAX", 32768, sim_random_range=(16, 32768))
+        init("COMMIT_TRANSACTION_BATCH_BYTES_MAX", 8 << 20)
+        # Resolver
+        init("SAMPLE_OFFSET_PER_KEY", 100)
+        init("KEY_BYTES_PER_SAMPLE", 2e4)
+        # TPU resolver (new): batch-size buckets compiled ahead of time; a
+        # batch is padded up to the next bucket to avoid XLA recompiles.
+        init("TPU_BATCH_BUCKETS", (256, 1024, 4096, 16384, 65536))
+        init("TPU_HISTORY_CAPACITY", 1 << 20)
+        # Storage (ref: fdbserver/Knobs.cpp storage section)
+        init("STORAGE_DURABILITY_LAG_VERSIONS", 5 * 1_000_000)
+        init("STORAGE_COMMIT_INTERVAL", 0.5)
+        # Ratekeeper
+        init("RATEKEEPER_UPDATE_INTERVAL", 0.25)
+        init("TARGET_BYTES_PER_STORAGE_SERVER", 1000e6)
+        # Recovery / leader election
+        init("CANDIDATE_MIN_DELAY", 0.05)
+        init("CANDIDATE_MAX_DELAY", 1.0)
+        init("POLLING_FREQUENCY", 1.0)
+        init("HEARTBEAT_FREQUENCY", 0.5)
+
+
+class ClientKnobs(Knobs):
+    def initialize(self, randomize: bool, random) -> None:
+        init = self.init
+        # (ref: fdbclient/Knobs.cpp)
+        init("TRANSACTION_SIZE_LIMIT", 10_000_000)
+        init("KEY_SIZE_LIMIT", 10_000)
+        init("VALUE_SIZE_LIMIT", 100_000)
+        init("SYSTEM_KEY_SIZE_LIMIT", 30_000)
+        init("MAX_BATCH_SIZE", 1000)
+        init("GRV_BATCH_INTERVAL", 0.001)
+        init("DEFAULT_BACKOFF", 0.01)
+        init("DEFAULT_MAX_BACKOFF", 1.0)
+        init("BACKOFF_GROWTH_RATE", 2.0)
+
+
+SERVER_KNOBS = ServerKnobs()
+CLIENT_KNOBS = ClientKnobs()

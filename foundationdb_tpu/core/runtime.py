@@ -1,0 +1,456 @@
+"""Deterministic cooperative runtime: futures, actors, and the event loop.
+
+This is the framework's equivalent of the reference's Flow runtime
+(flow/flow.h futures/actors, flow/Net2.actor.cpp event loop, flow/network.h
+INetwork seam). Design decisions, TPU-first rationale:
+
+- Single-threaded cooperative scheduling, exactly like Flow. Determinism is
+  the product requirement (replayable simulation, §4 of SURVEY.md); threads
+  would forfeit it. The TPU data plane is driven from this loop as batched
+  device steps, so host-side concurrency stays control-plane-only.
+- Actors are plain `async def` coroutines awaiting `Future`s — the idiomatic
+  Python analogue of the reference's ACTOR-compiled state machines
+  (flow/actorcompiler/). No source translator is needed.
+- Virtual time vs real time are two `Clock` implementations behind one event
+  loop, mirroring Net2 (real) vs Sim2 (simulated) behind INetwork
+  (flow/network.h:193, fdbrpc/sim2.actor.cpp:720). Simulation jumps the clock
+  to the next timer; real mode sleeps.
+- Completed futures resume their waiters through the ready queue (FIFO within
+  a priority level, ordered by a monotone sequence number) — scheduling is a
+  pure function of (seed, program), which is what makes runs replayable.
+"""
+
+from __future__ import annotations
+
+import heapq
+import time as _time
+from typing import Any, Awaitable, Callable, Coroutine, Optional, TypeVar
+
+from .errors import ActorCancelled, BrokenPromise, FdbError, TimedOut
+from .rand import DeterministicRandom, UID
+
+T = TypeVar("T")
+
+
+# Task priorities, highest runs first (subset of the reference's 40+ named
+# levels, flow/network.h:31-74).
+class TaskPriority:
+    MAX = 1000000
+    RUN_LOOP = 30000
+    COORDINATION = 20000
+    FAILURE_MONITOR = 8700
+    RESOLVER = 8700
+    TLOG_COMMIT = 8650
+    PROXY_COMMIT = 8580
+    GRV = 8500
+    DEFAULT_DELAY = 7010
+    DEFAULT = 7000
+    STORAGE = 5000
+    DATA_DISTRIBUTION = 3500
+    LOW = 2000
+    MIN = 1000
+
+
+_PENDING = 0
+_SET = 1
+_ERROR = 2
+
+
+class Future:
+    """Single-assignment asynchronous value (ref: SAV<T>, flow/flow.h:347).
+
+    Awaitable from actors. Callbacks fire when the value is set; actor
+    resumption goes through the loop's ready queue for deterministic ordering.
+    """
+
+    __slots__ = ("_state", "_value", "_callbacks", "_priority")
+
+    def __init__(self):
+        self._state = _PENDING
+        self._value: Any = None
+        self._callbacks: list[Callable[[Future], None]] = []
+        # When set, actors resuming from this future are scheduled at this
+        # priority instead of their spawn priority (used by delay/yield_).
+        self._priority: Optional[int] = None
+
+    # -- inspection --
+    def is_ready(self) -> bool:
+        return self._state != _PENDING
+
+    def is_error(self) -> bool:
+        return self._state == _ERROR
+
+    def is_set(self) -> bool:
+        return self._state == _SET
+
+    def get(self) -> Any:
+        if self._state == _SET:
+            return self._value
+        if self._state == _ERROR:
+            raise self._value
+        raise RuntimeError("Future.get() on pending future")
+
+    def error(self) -> Optional[BaseException]:
+        return self._value if self._state == _ERROR else None
+
+    # -- completion (used via Promise) --
+    def _send(self, value: Any) -> None:
+        if self._state != _PENDING:
+            raise RuntimeError("Future already set")
+        self._state = _SET
+        self._value = value
+        self._fire()
+
+    def _send_error(self, err: BaseException) -> None:
+        if self._state != _PENDING:
+            raise RuntimeError("Future already set")
+        self._state = _ERROR
+        self._value = err
+        self._fire()
+
+    def _fire(self) -> None:
+        cbs, self._callbacks = self._callbacks, []
+        for cb in cbs:
+            cb(self)
+
+    def add_callback(self, cb: Callable[[Future], None]) -> None:
+        if self._state != _PENDING:
+            cb(self)
+        else:
+            self._callbacks.append(cb)
+
+    def remove_callback(self, cb: Callable[[Future], None]) -> None:
+        try:
+            self._callbacks.remove(cb)
+        except ValueError:
+            pass
+
+    def __await__(self):
+        if self._state == _PENDING:
+            yield self
+        return self.get()
+
+
+def ready_future(value: Any = None) -> Future:
+    f = Future()
+    f._send(value)
+    return f
+
+
+def error_future(err: BaseException) -> Future:
+    f = Future()
+    f._send_error(err)
+    return f
+
+
+class Promise:
+    """Write side of a Future (ref: Promise<T>, flow/flow.h:705).
+
+    Dropping an unfulfilled Promise breaks waiters with BrokenPromise, like
+    the reference; here that is explicit via `drop()` (Python GC timing is
+    nondeterministic, so we never rely on __del__).
+    """
+
+    __slots__ = ("future",)
+
+    def __init__(self):
+        self.future = Future()
+
+    def send(self, value: Any = None) -> None:
+        self.future._send(value)
+
+    def send_error(self, err: BaseException) -> None:
+        self.future._send_error(err)
+
+    def is_set(self) -> bool:
+        return self.future.is_ready()
+
+    def drop(self) -> None:
+        if not self.future.is_ready():
+            self.future._send_error(BrokenPromise())
+
+
+class Task:
+    """A running actor: a coroutine plus its completion future."""
+
+    __slots__ = ("coro", "done", "priority", "loop", "_waiting_on", "_resume_cb", "_cancelled", "name")
+
+    def __init__(self, coro: Coroutine, priority: int, loop: "EventLoop", name: str = ""):
+        self.coro = coro
+        self.done = Future()
+        self.priority = priority
+        self.loop = loop
+        self.name = name or coro.__qualname__
+        self._waiting_on: Optional[Future] = None
+        self._resume_cb = None
+        self._cancelled = False
+
+    def cancel(self) -> None:
+        """Cancel the actor (ref: actor_cancelled on future drop)."""
+        if self.done.is_ready() or self._cancelled:
+            return
+        self._cancelled = True
+        loop = self.loop
+        if self._waiting_on is not None and self._resume_cb is not None:
+            self._waiting_on.remove_callback(self._resume_cb)
+            self._waiting_on = None
+            self._resume_cb = None
+            loop._schedule_step(self, None, ActorCancelled())
+        # If currently on the ready queue, the pending step will observe
+        # _cancelled and throw into the coroutine.
+
+
+class Clock:
+    def now(self) -> float:
+        raise NotImplementedError
+
+    def advance_to(self, t: float) -> None:
+        raise NotImplementedError
+
+    def is_simulated(self) -> bool:
+        raise NotImplementedError
+
+
+class SimClock(Clock):
+    """Virtual time: advancing is free; runs are seed-deterministic."""
+
+    def __init__(self, start: float = 0.0):
+        self._now = start
+
+    def now(self) -> float:
+        return self._now
+
+    def advance_to(self, t: float) -> None:
+        assert t >= self._now
+        self._now = t
+
+    def is_simulated(self) -> bool:
+        return True
+
+
+class RealClock(Clock):
+    def __init__(self):
+        self._origin = _time.monotonic()
+
+    def now(self) -> float:
+        return _time.monotonic() - self._origin
+
+    def advance_to(self, t: float) -> None:
+        remaining = t - self.now()
+        if remaining > 0:
+            _time.sleep(remaining)
+
+    def is_simulated(self) -> bool:
+        return False
+
+
+class EventLoop:
+    """The run loop (ref: Net2::run, flow/Net2.actor.cpp:544).
+
+    Ready tasks run before time advances; time then jumps (sim) or sleeps
+    (real) to the earliest timer. Priority-ordered, FIFO within priority.
+    """
+
+    def __init__(self, clock: Optional[Clock] = None, seed: int = 1):
+        self.clock = clock or RealClock()
+        self.random = DeterministicRandom(seed)
+        self._ready: list[tuple[int, int, Task, Any, Optional[BaseException]]] = []
+        self._timers: list[tuple[float, int, int, Promise]] = []
+        self._seq = 0
+        self._steps_at_instant = 0  # livelock guard: steps since time last advanced
+        self._stopped = False
+        self._buggify_enabled: dict[str, bool] = {}
+        self.buggify_on = False
+        self.tasks_run = 0
+        self.current_task: Optional[Task] = None
+
+    # -- time --
+    def now(self) -> float:
+        return self.clock.now()
+
+    def is_simulated(self) -> bool:
+        return self.clock.is_simulated()
+
+    def delay(self, seconds: float, priority: int = TaskPriority.DEFAULT_DELAY) -> Future:
+        """Future that fires `seconds` from now (ref: INetwork::delay).
+
+        Timers at the same instant fire in priority order; the awaiting actor
+        resumes at `priority`, so `delay(0, p)` is a priority-changing yield
+        exactly like the reference's.
+        """
+        p = Promise()
+        p.future._priority = priority
+        self._seq += 1
+        heapq.heappush(self._timers, (self.now() + max(0.0, seconds), -priority, self._seq, p))
+        return p.future
+
+    def yield_(self, priority: int = TaskPriority.DEFAULT) -> Future:
+        return self.delay(0.0, priority)
+
+    # -- actors --
+    def spawn(self, coro: Coroutine, priority: int = TaskPriority.DEFAULT, name: str = "") -> Task:
+        task = Task(coro, priority, self, name)
+        self._schedule_step(task, None, None)
+        return task
+
+    def _schedule_step(
+        self, task: Task, value: Any, exc: Optional[BaseException], priority: Optional[int] = None
+    ) -> None:
+        self._seq += 1
+        heapq.heappush(self._ready, (-(priority if priority is not None else task.priority), self._seq, task, value, exc))
+
+    def _step(self, task: Task, value: Any, exc: Optional[BaseException]) -> None:
+        if task.done.is_ready():
+            return
+        if task._cancelled and exc is None:
+            exc = ActorCancelled()
+        task._waiting_on = None
+        task._resume_cb = None
+        self.tasks_run += 1
+        prev = self.current_task
+        self.current_task = task
+        try:
+            if exc is not None:
+                fut = task.coro.throw(exc)
+            else:
+                fut = task.coro.send(value)
+        except StopIteration as e:
+            task.done._send(e.value)
+        except ActorCancelled as e:
+            task.done._send_error(e)
+        except BaseException as e:  # noqa: BLE001 — errors propagate via the future
+            task.done._send_error(e)
+        else:
+            if not isinstance(fut, Future):
+                raise TypeError(f"actor {task.name} awaited non-Future {fut!r}")
+            task._waiting_on = fut
+
+            def resume(f: Future, task=task):
+                if f.is_error():
+                    self._schedule_step(task, None, f._value, f._priority)
+                else:
+                    self._schedule_step(task, f._value, None, f._priority)
+
+            task._resume_cb = resume
+            fut.add_callback(resume)
+        finally:
+            self.current_task = prev
+
+    # -- running --
+    def stop(self) -> None:
+        self._stopped = True
+
+    # Steps allowed at one virtual instant before declaring a livelock: a
+    # `while True: await delay(0)` actor never advances SimClock, so the
+    # wall-time-free deadline in run_until would otherwise spin forever.
+    LIVELOCK_STEP_LIMIT = 10_000_000
+
+    def run_one(self) -> bool:
+        """Run until one unit of progress is made. Returns False when idle."""
+        if self._ready:
+            _, _, task, value, exc = heapq.heappop(self._ready)
+            self._steps_at_instant += 1
+            if self._steps_at_instant > self.LIVELOCK_STEP_LIMIT:
+                raise RuntimeError(
+                    f"livelock: {self._steps_at_instant} steps without time advancing (t={self.now()})"
+                )
+            self._step(task, value, exc)
+            return True
+        if self._timers:
+            t, _, _, _ = self._timers[0]
+            if t > self.now():
+                self._steps_at_instant = 0
+            self.clock.advance_to(t)
+            while self._timers and self._timers[0][0] <= self.now():
+                _, _, _, p = heapq.heappop(self._timers)
+                if not p.is_set():
+                    p.send(None)
+            return True
+        return False
+
+    def run_until(self, fut: Future, timeout_sim_seconds: float = 1e9) -> Any:
+        """Drive the loop until `fut` resolves; returns/raises its value."""
+        deadline = self.now() + timeout_sim_seconds
+        while not fut.is_ready():
+            if self._stopped:
+                raise RuntimeError("event loop stopped")
+            if not self.run_one():
+                raise RuntimeError("deadlock: future not ready and loop idle")
+            if self.now() > deadline:
+                raise TimedOut(f"run_until exceeded {timeout_sim_seconds}s of loop time")
+        return fut.get()
+
+    def run(self, main: Coroutine, timeout_sim_seconds: float = 1e9) -> Any:
+        task = self.spawn(main, name="main")
+        return self.run_until(task.done, timeout_sim_seconds)
+
+    # -- fault injection (ref: BUGGIFY, flow/flow.h:55-67) --
+    def buggify(self, site: str, fire_probability: float = 0.25) -> bool:
+        """Randomly returns True at an enabled site, only in simulation."""
+        if not self.buggify_on:
+            return False
+        enabled = self._buggify_enabled.get(site)
+        if enabled is None:
+            enabled = self.random.coinflip(0.25)
+            self._buggify_enabled[site] = enabled
+        return enabled and self.random.coinflip(fire_probability)
+
+
+# -- global current-loop access (ref: g_network / g_random globals) --
+
+_current: Optional[EventLoop] = None
+
+
+def current_loop() -> EventLoop:
+    if _current is None:
+        raise RuntimeError("no event loop is current; use loop_context() or EventLoop().run()")
+    return _current
+
+
+def set_current_loop(loop: Optional[EventLoop]) -> None:
+    global _current
+    _current = loop
+
+
+class loop_context:
+    def __init__(self, loop: EventLoop):
+        self.loop = loop
+
+    def __enter__(self) -> EventLoop:
+        self._prev = _current
+        set_current_loop(self.loop)
+        return self.loop
+
+    def __exit__(self, *exc):
+        set_current_loop(self._prev)
+
+
+def sim_loop(seed: int = 1, buggify: bool = False) -> EventLoop:
+    loop = EventLoop(SimClock(), seed=seed)
+    loop.buggify_on = buggify
+    return loop
+
+
+# Convenience module-level API used inside actors.
+def now() -> float:
+    return current_loop().now()
+
+
+def delay(seconds: float, priority: int = TaskPriority.DEFAULT_DELAY) -> Future:
+    return current_loop().delay(seconds, priority)
+
+
+def spawn(coro: Coroutine, priority: int = TaskPriority.DEFAULT, name: str = "") -> Task:
+    return current_loop().spawn(coro, priority, name)
+
+
+def g_random() -> DeterministicRandom:
+    return current_loop().random
+
+
+def buggify(site: str, fire_probability: float = 0.25) -> bool:
+    return current_loop().buggify(site, fire_probability)
+
+
+def deterministic_random_uid() -> UID:
+    return current_loop().random.random_unique_id()

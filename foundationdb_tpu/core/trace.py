@@ -1,0 +1,119 @@
+"""Structured trace events (ref: flow/Trace.h TraceEvent).
+
+JSONL instead of the reference's XML; same shape: typed events with
+severity, machine-readable details, per-process files, and suppression of
+floods. TraceBatch-style micro events share the sink.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+SevDebug = 5
+SevInfo = 10
+SevWarn = 20
+SevWarnAlways = 30
+SevError = 40
+
+
+class TraceSink:
+    """Collects events in memory; optionally appends JSONL to a file."""
+
+    # Per-type flood suppression: after this many events of one type, further
+    # ones are dropped and counted (a TraceEventsSuppressed event is emitted
+    # once per suppressed type). SevError and above are never suppressed.
+    TYPE_LIMIT = 25_000
+
+    def __init__(self, path: Optional[str] = None, keep_in_memory: bool = True, memory_limit: int = 100_000):
+        self.path = path
+        self.keep = keep_in_memory
+        self.memory_limit = memory_limit
+        self.events: list[dict] = []
+        self._fh = open(path, "a", buffering=1) if path else None
+        self._type_counts: dict[str, int] = {}
+        self.suppressed: dict[str, int] = {}
+
+    def emit(self, event: dict) -> None:
+        etype = event.get("Type", "")
+        n = self._type_counts.get(etype, 0) + 1
+        self._type_counts[etype] = n
+        if n > self.TYPE_LIMIT and event.get("Severity", 0) < SevError:
+            if etype not in self.suppressed:
+                self.suppressed[etype] = 0
+                self.emit({"Type": "TraceEventsSuppressed", "Severity": SevWarn, "SuppressedType": etype})
+            self.suppressed[etype] += 1
+            return
+        if self.keep:
+            self.events.append(event)
+            if len(self.events) > self.memory_limit:
+                del self.events[: self.memory_limit // 2]
+        if self._fh:
+            self._fh.write(json.dumps(event, default=str) + "\n")
+
+    def close(self):
+        if self._fh:
+            self._fh.close()
+            self._fh = None
+
+    def count(self, event_type: str) -> int:
+        return sum(1 for e in self.events if e.get("Type") == event_type)
+
+    def find(self, event_type: str) -> list[dict]:
+        return [e for e in self.events if e.get("Type") == event_type]
+
+    def has_severity(self, at_least: int) -> list[dict]:
+        return [e for e in self.events if e.get("Severity", 0) >= at_least]
+
+
+_global_sink = TraceSink()
+
+
+def global_sink() -> TraceSink:
+    return _global_sink
+
+
+def set_global_sink(sink: TraceSink) -> TraceSink:
+    global _global_sink
+    _global_sink = sink
+    return sink
+
+
+class TraceEvent:
+    """Fluent structured event: TraceEvent("CommitBatch").detail("Txns", n).log()."""
+
+    __slots__ = ("_event", "_sink", "_logged")
+
+    def __init__(self, event_type: str, severity: int = SevInfo, sink: Optional[TraceSink] = None):
+        t = None
+        try:
+            from .runtime import current_loop
+
+            t = current_loop().now()
+        except RuntimeError:
+            pass
+        self._event: dict[str, Any] = {"Type": event_type, "Severity": severity, "Time": t}
+        self._sink = sink or _global_sink
+        self._logged = False
+
+    def detail(self, key: str, value: Any) -> "TraceEvent":
+        self._event[key] = value
+        return self
+
+    def error(self, err: BaseException) -> "TraceEvent":
+        self._event["Error"] = getattr(err, "name", type(err).__name__)
+        self._event["ErrorCode"] = getattr(err, "code", None)
+        if self._event["Severity"] < SevWarn:
+            self._event["Severity"] = SevWarn
+        return self
+
+    def log(self) -> None:
+        if not self._logged:
+            self._logged = True
+            self._sink.emit(self._event)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.log()

@@ -1,0 +1,27 @@
+"""Test configuration.
+
+Tests run on a virtual 8-device CPU mesh: real multi-chip TPU hardware is not
+available in CI, so sharding/collective code paths are validated on the host
+platform with forced device count (the driver separately dry-run-compiles the
+multi-chip path via __graft_entry__.dryrun_multichip). This must be set
+before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def sim():
+    """A fresh deterministic simulation loop, made current for the test."""
+    from foundationdb_tpu.core import loop_context, sim_loop
+
+    loop = sim_loop(seed=12345)
+    with loop_context(loop):
+        yield loop
