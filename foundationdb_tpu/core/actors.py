@@ -66,11 +66,8 @@ def any_of(futures: list[Future]) -> Future:
     return out.future
 
 
-def timeout(fut: Future, seconds: float, default: Any = None) -> Future:
-    """Value of fut, or `default` after `seconds` (ref: timeout, genericactors)."""
-    loop = current_loop()
+def _with_timer(fut: Future, seconds: float, on_expiry) -> Future:
     out = Promise()
-    timer = loop.delay(seconds)
 
     def on_fut(f: Future):
         if out.is_set():
@@ -82,33 +79,21 @@ def timeout(fut: Future, seconds: float, default: Any = None) -> Future:
 
     def on_timer(_):
         if not out.is_set():
-            out.send(default)
+            on_expiry(out)
 
     fut.add_callback(on_fut)
-    timer.add_callback(on_timer)
+    current_loop().delay(seconds).add_callback(on_timer)
     return out.future
+
+
+def timeout(fut: Future, seconds: float, default: Any = None) -> Future:
+    """Value of fut, or `default` after `seconds` (ref: timeout, genericactors)."""
+    return _with_timer(fut, seconds, lambda out: out.send(default))
 
 
 def timeout_error(fut: Future, seconds: float) -> Future:
     """Like timeout(), but raises TimedOut instead of a default value."""
-    loop = current_loop()
-    out = Promise()
-
-    def on_fut(f: Future):
-        if out.is_set():
-            return
-        if f.is_error():
-            out.send_error(f._value)
-        else:
-            out.send(f._value)
-
-    def on_timer(_):
-        if not out.is_set():
-            out.send_error(TimedOut())
-
-    fut.add_callback(on_fut)
-    loop.delay(seconds).add_callback(on_timer)
-    return out.future
+    return _with_timer(fut, seconds, lambda out: out.send_error(TimedOut()))
 
 
 class PromiseStream(Generic[T]):
@@ -145,12 +130,28 @@ class PromiseStream(Generic[T]):
 
     def pop(self) -> Future:
         if self._queue:
-            return ready_future(self._queue.popleft())
+            f = ready_future(self._queue.popleft())
+            # If the popping actor dies before consuming, the value returns
+            # to the front of the queue (the reference keeps unconsumed
+            # values in the FutureStream queue across waiter cancellation).
+            f._abandon_cb = lambda fut: self._queue.appendleft(fut._value)
+            return f
         if self._closed is not None:
             p = Promise()
             p.send_error(self._closed)
             return p.future
         p = Promise()
+
+        def abandoned(fut: Future):
+            if fut.is_set():
+                self._queue.appendleft(fut._value)
+            else:
+                try:
+                    self._waiters.remove(p)
+                except ValueError:
+                    pass
+
+        p.future._abandon_cb = abandoned
         self._waiters.append(p)
         return p.future
 

@@ -63,7 +63,7 @@ class Future:
     resumption goes through the loop's ready queue for deterministic ordering.
     """
 
-    __slots__ = ("_state", "_value", "_callbacks", "_priority")
+    __slots__ = ("_state", "_value", "_callbacks", "_priority", "_abandon_cb")
 
     def __init__(self):
         self._state = _PENDING
@@ -72,6 +72,16 @@ class Future:
         # When set, actors resuming from this future are scheduled at this
         # priority instead of their spawn priority (used by delay/yield_).
         self._priority: Optional[int] = None
+        # Invoked when the actor awaiting this future is cancelled, so value
+        # sources (e.g. PromiseStream) can reclaim an undelivered value —
+        # mirrors the reference, where a value popped-at by a dying actor
+        # stays in the FutureStream queue (flow/flow.h:756-833).
+        self._abandon_cb: Optional[Callable[["Future"], None]] = None
+
+    def notify_abandoned(self) -> None:
+        if self._abandon_cb is not None:
+            cb, self._abandon_cb = self._abandon_cb, None
+            cb(self)
 
     # -- inspection --
     def is_ready(self) -> bool:
@@ -193,6 +203,7 @@ class Task:
         loop = self.loop
         if self._waiting_on is not None and self._resume_cb is not None:
             self._waiting_on.remove_callback(self._resume_cb)
+            self._waiting_on.notify_abandoned()
             self._waiting_on = None
             self._resume_cb = None
             loop._schedule_step(self, None, ActorCancelled())

@@ -1,0 +1,21 @@
+"""Optimistic conflict resolution — the framework's north-star component.
+
+The reference implements this as a versioned skip list walked per read range
+(fdbserver/SkipList.cpp, fdbserver/ConflictSet.h). Here the same contract is
+provided by two interchangeable backends:
+
+- `ConflictSetCPU` (cpu.py): an exact step-function reference, the oracle for
+  differential testing.
+- `ConflictSetTPU` (tpu.py): the batched JAX kernel — sorted interval tensors,
+  rank merging, sparse-table range-max and a segment-tree min-index fixed
+  point, all under jit, sized for 64K-1M transaction batches.
+"""
+
+from .types import (  # noqa: F401
+    COMMITTED,
+    CONFLICT,
+    TOO_OLD,
+    ConflictBatchResult,
+    TxnConflictInfo,
+)
+from .cpu import ConflictSetCPU  # noqa: F401

@@ -1,0 +1,272 @@
+"""Unit + model-based tests for the CPU reference conflict set.
+
+The brute-force model tracks the full list of (write range, version) in
+commit order and evaluates version_at(x) as the last write covering x —
+an independent restatement of the semantics, diffed against the
+step-function implementation on randomized batches.
+"""
+
+import random
+
+from foundationdb_tpu.kv.keys import KeyRange, key_after
+from foundationdb_tpu.resolver import (
+    COMMITTED,
+    CONFLICT,
+    TOO_OLD,
+    ConflictSetCPU,
+    TxnConflictInfo,
+)
+
+
+def txn(snap, reads=(), writes=()):
+    return TxnConflictInfo(
+        read_snapshot=snap,
+        read_ranges=[KeyRange(b, e) for b, e in reads],
+        write_ranges=[KeyRange(b, e) for b, e in writes],
+    )
+
+
+class TestBasics:
+    def test_blind_write_commits(self):
+        cs = ConflictSetCPU()
+        r = cs.resolve(10, 0, [txn(5, writes=[(b"a", b"b")])])
+        assert r.statuses == [COMMITTED]
+
+    def test_read_after_write_conflicts(self):
+        cs = ConflictSetCPU()
+        cs.resolve(10, 0, [txn(5, writes=[(b"a", b"b")])])
+        # snapshot 5 < write version 10 -> conflict
+        r = cs.resolve(20, 0, [txn(5, reads=[(b"a", b"b")], writes=[(b"x", b"y")])])
+        assert r.statuses == [CONFLICT]
+
+    def test_read_at_later_snapshot_commits(self):
+        cs = ConflictSetCPU()
+        cs.resolve(10, 0, [txn(5, writes=[(b"a", b"b")])])
+        r = cs.resolve(20, 0, [txn(10, reads=[(b"a", b"b")])])
+        assert r.statuses == [COMMITTED]
+
+    def test_disjoint_ranges_no_conflict(self):
+        cs = ConflictSetCPU()
+        cs.resolve(10, 0, [txn(5, writes=[(b"a", b"b")])])
+        r = cs.resolve(20, 0, [txn(5, reads=[(b"b", b"c")])])
+        assert r.statuses == [COMMITTED], "write [a,b) must not conflict read [b,c)"
+
+    def test_adjacent_below_no_conflict(self):
+        cs = ConflictSetCPU()
+        cs.resolve(10, 0, [txn(5, writes=[(b"m", b"n")])])
+        r = cs.resolve(20, 0, [txn(5, reads=[(b"a", b"m")])])
+        assert r.statuses == [COMMITTED], "write [m,n) must not conflict read [a,m)"
+
+    def test_single_key_overlap(self):
+        cs = ConflictSetCPU()
+        cs.resolve(10, 0, [txn(5, writes=[(b"k", key_after(b"k"))])])
+        r = cs.resolve(20, 0, [txn(5, reads=[(b"k", key_after(b"k"))])])
+        assert r.statuses == [CONFLICT]
+
+    def test_read_spanning_write_begin(self):
+        cs = ConflictSetCPU()
+        cs.resolve(10, 0, [txn(5, writes=[(b"c", b"f")])])
+        # read [a, d) overlaps [c, f) only in [c, d)
+        r = cs.resolve(20, 0, [txn(5, reads=[(b"a", b"d")])])
+        assert r.statuses == [CONFLICT]
+
+    def test_read_inside_old_write_region(self):
+        cs = ConflictSetCPU()
+        cs.resolve(10, 0, [txn(5, writes=[(b"a", b"z")])])
+        cs.resolve(20, 0, [txn(15, writes=[(b"m", b"n")])])
+        # [n, p) is still at version 10 (end-value restored on overwrite)
+        r = cs.resolve(30, 0, [txn(12, reads=[(b"n", b"p")])])
+        assert r.statuses == [COMMITTED]
+        r = cs.resolve(40, 0, [txn(12, reads=[(b"m", b"n")])])
+        assert r.statuses == [CONFLICT]
+
+
+class TestTooOld:
+    def test_too_old(self):
+        cs = ConflictSetCPU()
+        cs.resolve(10, 8, [txn(5, writes=[(b"a", b"b")])])
+        assert cs.oldest_version == 8
+        r = cs.resolve(20, 8, [txn(7, reads=[(b"q", b"r")])])
+        assert r.statuses == [TOO_OLD]
+
+    def test_write_only_txn_never_too_old(self):
+        cs = ConflictSetCPU()
+        cs.resolve(10, 8, [txn(5, writes=[(b"a", b"b")])])
+        r = cs.resolve(20, 8, [txn(0, writes=[(b"q", b"r")])])
+        assert r.statuses == [COMMITTED]
+
+    def test_too_old_writes_not_merged(self):
+        cs = ConflictSetCPU()
+        cs.resolve(10, 8, [txn(5, writes=[(b"a", b"b")])])
+        cs.resolve(20, 8, [txn(7, reads=[(b"q", b"r")], writes=[(b"s", b"t")])])
+        r = cs.resolve(30, 8, [txn(15, reads=[(b"s", b"t")])])
+        assert r.statuses == [COMMITTED], "TooOld txn's writes must not enter history"
+
+
+class TestIntraBatch:
+    def test_earlier_writer_aborts_later_reader(self):
+        cs = ConflictSetCPU()
+        r = cs.resolve(
+            10,
+            0,
+            [
+                txn(5, writes=[(b"a", b"b")]),
+                txn(5, reads=[(b"a", b"b")]),
+            ],
+        )
+        assert r.statuses == [COMMITTED, CONFLICT]
+
+    def test_later_writer_does_not_abort_earlier_reader(self):
+        cs = ConflictSetCPU()
+        r = cs.resolve(
+            10,
+            0,
+            [
+                txn(5, reads=[(b"a", b"b")]),
+                txn(5, writes=[(b"a", b"b")]),
+            ],
+        )
+        assert r.statuses == [COMMITTED, COMMITTED]
+
+    def test_aborted_txn_writes_do_not_count(self):
+        """Chain: t0 writes k; t1 reads k (aborts) and writes m; t2 reads m.
+        t1's write to m must NOT abort t2, because t1 itself aborted."""
+        cs = ConflictSetCPU()
+        r = cs.resolve(
+            10,
+            0,
+            [
+                txn(5, writes=[(b"k", b"l")]),
+                txn(5, reads=[(b"k", b"l")], writes=[(b"m", b"n")]),
+                txn(5, reads=[(b"m", b"n")]),
+            ],
+        )
+        assert r.statuses == [COMMITTED, CONFLICT, COMMITTED]
+
+    def test_history_aborted_txn_writes_do_not_count(self):
+        cs = ConflictSetCPU()
+        cs.resolve(10, 0, [txn(5, writes=[(b"h", b"i")])])
+        # t0 conflicts with history; its write to m must not abort t1.
+        r = cs.resolve(
+            20,
+            0,
+            [
+                txn(5, reads=[(b"h", b"i")], writes=[(b"m", b"n")]),
+                txn(15, reads=[(b"m", b"n")]),
+            ],
+        )
+        assert r.statuses == [CONFLICT, COMMITTED]
+
+    def test_intra_batch_boundary_touch_is_not_conflict(self):
+        cs = ConflictSetCPU()
+        r = cs.resolve(
+            10,
+            0,
+            [
+                txn(5, writes=[(b"a", b"m")]),
+                txn(5, reads=[(b"m", b"z")]),
+            ],
+        )
+        assert r.statuses == [COMMITTED, COMMITTED]
+
+
+class TestGC:
+    def test_gc_collapses_but_preserves_answers(self):
+        cs = ConflictSetCPU()
+        for i in range(10):
+            key = bytes([ord("a") + i])
+            cs.resolve(10 + i, 0, [txn(5 + i, writes=[(key, key_after(key))])])
+        size_before = len(cs)
+        cs.resolve(100, 50, [txn(99, writes=[(b"zz", b"zzz")])])
+        assert cs.oldest_version == 50
+        assert len(cs) < size_before
+        # Old-region reads at live snapshots still commit.
+        r = cs.resolve(110, 50, [txn(60, reads=[(b"a", b"m")])])
+        assert r.statuses == [COMMITTED]
+
+
+class BruteModel:
+    """Independent model: full write log, version_at = last covering write."""
+
+    def __init__(self, init_version=0):
+        self.writes = []  # (begin, end, version) in commit order
+        self.init_version = init_version
+        self.oldest = 0
+
+    def version_at(self, key):
+        v = self.init_version
+        for b, e, ver in self.writes:
+            if b <= key < e:
+                v = ver
+        return v
+
+    def max_in(self, begin, end):
+        points = {begin}
+        for b, e, _ in self.writes:
+            if begin <= b < end:
+                points.add(b)
+            if begin <= e < end:
+                points.add(e)
+        return max(self.version_at(p) for p in points)
+
+    def resolve(self, version, new_oldest, txns):
+        statuses = []
+        batch_writes = []  # committed-so-far in this batch
+        for t in txns:
+            if t.read_snapshot < self.oldest and t.read_ranges:
+                statuses.append(TOO_OLD)
+                continue
+            conflict = any(
+                self.max_in(r.begin, r.end) > t.read_snapshot for r in t.read_ranges
+            )
+            if not conflict:
+                for r in t.read_ranges:
+                    for w in batch_writes:
+                        if w.begin < r.end and w.end > r.begin:
+                            conflict = True
+            if conflict:
+                statuses.append(CONFLICT)
+            else:
+                statuses.append(COMMITTED)
+                batch_writes.extend(t.write_ranges)
+        for t, s in zip(txns, statuses):
+            if s == COMMITTED:
+                for w in t.write_ranges:
+                    self.writes.append((w.begin, w.end, version))
+        self.oldest = max(self.oldest, new_oldest)
+        return statuses
+
+
+def random_key(rng, depth=3):
+    alphabet = [b"a", b"b", b"c", b"d", b"e", b"\x00", b"\xff"]
+    return b"".join(rng.choice(alphabet) for _ in range(rng.randint(1, depth)))
+
+
+def random_range(rng):
+    a, b = random_key(rng), random_key(rng)
+    if a == b:
+        b = key_after(a)
+    return KeyRange(min(a, b), max(a, b))
+
+
+def test_differential_vs_brute_model():
+    rng = random.Random(0xF0DB)
+    for trial in range(30):
+        cs = ConflictSetCPU()
+        model = BruteModel()
+        version = 0
+        for batch_i in range(12):
+            version += rng.randint(1, 100)
+            new_oldest = max(0, version - 150)
+            txns = []
+            for _ in range(rng.randint(1, 12)):
+                snap = max(0, version - rng.randint(1, 200))
+                reads = [random_range(rng) for _ in range(rng.randint(0, 3))]
+                writes = [random_range(rng) for _ in range(rng.randint(0, 3))]
+                txns.append(TxnConflictInfo(snap, reads, writes))
+            got = cs.resolve(version, new_oldest, txns).statuses
+            want = model.resolve(version, new_oldest, txns)
+            assert got == want, (
+                f"trial {trial} batch {batch_i} version {version}: {got} != {want}\n"
+                f"txns={txns}"
+            )

@@ -242,3 +242,56 @@ def test_buggify_determinism():
     loop = sim_loop(seed=7, buggify=False)
     with loop_context(loop):
         assert not any(loop.buggify("site_a") for _ in range(100))
+
+
+class TestStreamCancellation:
+    def test_value_not_lost_when_waiter_cancelled(self, sim):
+        """A value sent after the blocked consumer was cancelled must stay in
+        the queue for the next consumer (code-review finding)."""
+        from foundationdb_tpu.core import PromiseStream
+
+        s = PromiseStream()
+        received = []
+
+        async def consumer():
+            received.append(await s.pop())
+
+        async def main():
+            victim = sim.spawn(consumer())
+            await sim.delay(0.01)
+            victim.cancel()
+            await sim.delay(0.01)
+            s.send("A")
+            s.send("B")
+            keeper = sim.spawn(consumer())
+            keeper2 = sim.spawn(consumer())
+            await keeper.done
+            await keeper2.done
+
+        sim.run(main())
+        assert received == ["A", "B"]
+
+    def test_resolved_but_unconsumed_value_requeued(self, sim):
+        """Cancel after send resolved the waiter but before the consumer ran:
+        the value must return to the front of the queue."""
+        from foundationdb_tpu.core import PromiseStream
+
+        s = PromiseStream()
+        received = []
+
+        async def consumer():
+            received.append(await s.pop())
+
+        async def main():
+            victim = sim.spawn(consumer())
+            await sim.delay(0.01)
+            s.send("A")  # resolves victim's waiter; victim not yet resumed
+            victim.cancel()
+            s.send("B")
+            keeper = sim.spawn(consumer())
+            keeper2 = sim.spawn(consumer())
+            await keeper.done
+            await keeper2.done
+
+        sim.run(main())
+        assert received == ["A", "B"]
