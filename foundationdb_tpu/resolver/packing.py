@@ -1,0 +1,160 @@
+"""Host-side packing of conflict batches into fixed-shape integer tensors.
+
+Keys are arbitrary byte strings; the TPU kernel needs a fixed-width,
+order-preserving projection (SURVEY.md §7 step 2). The projection used here
+is exact, not approximate, for every key up to ``4 * n_words`` bytes:
+
+    key  ->  (w_0, ..., w_{n-1}, len)
+
+where w_i is bytes [4i, 4i+4) of the key, zero-padded, read big-endian as a
+uint32, and len is the byte length. Lexicographic comparison of the tuple
+equals lexicographic byte comparison of the keys: if any word differs the
+big-endian order matches byte order; if all words agree the shorter key is a
+prefix of the longer one up to zero padding, and the length tiebreak matches
+byte order exactly (the reference's compare, fdbserver/SkipList.cpp:113-120).
+Keys longer than the configured width raise KeyWidthError; callers either
+construct the set with a bigger width or route the batch to the CPU backend.
+
+Batch tensors are padded to power-of-two capacities so jit re-specializes on
+a small number of shape buckets (SURVEY.md §7 "batch-size bucketing").
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Sequence
+
+import numpy as np
+
+from .types import TxnConflictInfo
+
+INT32_MAX = np.int32(2**31 - 1)
+PAD_WORD = np.uint32(0xFFFFFFFF)
+# Snapshot used for padding read rows: larger than any real version, so a
+# padded row can never report a conflict even unmasked.
+PAD_SNAPSHOT = np.int64(2**62)
+
+
+class KeyWidthError(ValueError):
+    """A key exceeds the packed width supported by this conflict set."""
+
+
+def next_pow2(x: int, minimum: int = 8) -> int:
+    n = minimum
+    while n < x:
+        n *= 2
+    return n
+
+
+def pack_keys(keys: Sequence[bytes], n_words: int) -> tuple[np.ndarray, np.ndarray]:
+    """Pack keys into (N, n_words) uint32 words + (N,) int32 lengths."""
+    width = 4 * n_words
+    n = len(keys)
+    buf = np.zeros((n, width), dtype=np.uint8)
+    lens = np.empty(n, dtype=np.int32)
+    for i, k in enumerate(keys):
+        kl = len(k)
+        if kl > width:
+            raise KeyWidthError(f"key of {kl} bytes exceeds packed width {width}")
+        buf[i, :kl] = np.frombuffer(k, dtype=np.uint8)
+        lens[i] = kl
+    words = buf.reshape(n, n_words, 4).view(">u4")[..., 0].astype(np.uint32)
+    return words, lens
+
+
+@dataclass
+class PackedBatch:
+    """Fixed-shape tensors for one resolve() call. R/W rows beyond the valid
+    counts are padding (all-max keys, huge snapshots)."""
+
+    n_txns: int
+    # reads
+    rbw: np.ndarray  # (R, W) uint32
+    rbl: np.ndarray  # (R,) int32
+    rew: np.ndarray
+    rel: np.ndarray
+    rtxn: np.ndarray  # (R,) int32
+    rsnap: np.ndarray  # (R,) int64
+    # writes
+    wbw: np.ndarray
+    wbl: np.ndarray
+    wew: np.ndarray
+    wel: np.ndarray
+    wtxn: np.ndarray
+    w_valid: np.ndarray  # (Wr,) bool
+    # per-txn
+    too_old: np.ndarray  # (T,) bool
+
+
+def pack_batch(
+    txns: Sequence[TxnConflictInfo],
+    oldest_version: int,
+    n_words: int,
+) -> PackedBatch:
+    """Flatten a transaction batch into padded tensors.
+
+    tooOld transactions (read_snapshot < oldestVersion with read ranges)
+    contribute no ranges, exactly like the reference's addTransaction
+    (fdbserver/SkipList.cpp:979-987).
+    """
+    n_txns = len(txns)
+    too_old_l = [
+        t.read_snapshot < oldest_version and len(t.read_ranges) > 0 for t in txns
+    ]
+
+    r_begin: list[bytes] = []
+    r_end: list[bytes] = []
+    r_txn: list[int] = []
+    r_snap: list[int] = []
+    w_begin: list[bytes] = []
+    w_end: list[bytes] = []
+    w_txn: list[int] = []
+    for i, t in enumerate(txns):
+        if too_old_l[i]:
+            continue
+        for r in t.read_ranges:
+            if not r.is_empty():
+                r_begin.append(r.begin)
+                r_end.append(r.end)
+                r_txn.append(i)
+                r_snap.append(t.read_snapshot)
+        for w in t.write_ranges:
+            if not w.is_empty():
+                w_begin.append(w.begin)
+                w_end.append(w.end)
+                w_txn.append(i)
+
+    R = next_pow2(len(r_begin))
+    Wr = next_pow2(len(w_begin))
+    T = next_pow2(n_txns)
+
+    def padded_keys(keys: list[bytes], cap: int):
+        words, lens = pack_keys(keys, n_words)
+        pw = np.full((cap, n_words), PAD_WORD, dtype=np.uint32)
+        pl = np.full(cap, INT32_MAX, dtype=np.int32)
+        pw[: len(keys)] = words
+        pl[: len(keys)] = lens
+        return pw, pl
+
+    rbw, rbl = padded_keys(r_begin, R)
+    rew, rel = padded_keys(r_end, R)
+    wbw, wbl = padded_keys(w_begin, Wr)
+    wew, wel = padded_keys(w_end, Wr)
+
+    rtxn = np.zeros(R, dtype=np.int32)
+    rtxn[: len(r_txn)] = r_txn
+    rsnap = np.full(R, PAD_SNAPSHOT, dtype=np.int64)
+    rsnap[: len(r_snap)] = r_snap
+    wtxn = np.zeros(Wr, dtype=np.int32)
+    wtxn[: len(w_txn)] = w_txn
+    w_valid = np.zeros(Wr, dtype=bool)
+    w_valid[: len(w_txn)] = True
+    too_old = np.zeros(T, dtype=bool)
+    too_old[:n_txns] = too_old_l
+
+    return PackedBatch(
+        n_txns=n_txns,
+        rbw=rbw, rbl=rbl, rew=rew, rel=rel, rtxn=rtxn, rsnap=rsnap,
+        wbw=wbw, wbl=wbl, wew=wew, wel=wel, wtxn=wtxn, w_valid=w_valid,
+        too_old=too_old,
+    )

@@ -1,0 +1,418 @@
+"""Batched conflict detection as a JAX kernel — the north-star component.
+
+Replaces the reference's per-range skip-list walk (SkipList::detectConflicts,
+fdbserver/SkipList.cpp:524-553, driven by ConflictBatch::detectConflicts
+:1163-1208) with fixed-shape tensor passes sized for 64K-1M transaction
+batches, designed TPU-first:
+
+- History is a *step function* version(x) held on device as sorted packed-key
+  tensors (capacity-padded). A skip list answers one range at a time; the
+  step function answers the whole batch with one lexicographic sort + rank
+  merge + sparse-table range-max — sort and segmented reduce are what the
+  hardware is good at, pointer chasing is not.
+- Read-vs-history (CheckMax semantics, SkipList.cpp:755-837): for read
+  [b, e) at snapshot s, conflict iff max over history segments intersecting
+  [b, e) exceeds s. Ranks of b/e in the history come from one merged sort
+  (history keys + query endpoints + tag tiebreak) and an exclusive cumsum;
+  the interval max comes from an O(C log C) sparse table and two gathers.
+- Intra-batch (checkIntraBatchConflicts semantics, SkipList.cpp:1133-1158):
+  the sequential "reads of txn t vs writes of earlier still-committed txns"
+  rule is the unique fixed point of
+      A(t) = hist(t) | tooOld(t) | exists j < t: !A(j) and writes_j
+             overlap reads_t
+  (unique because A(t) depends only on A(j), j < t). We iterate to that
+  fixed point under lax.while_loop; each iteration is one vectorized
+  min-writer-index interval query: committed write ranges scatter their
+  writer index into a flat segment tree (range-min update via canonical
+  node decomposition, fixed log2 steps with masks), reads query min over
+  their span, and a read conflicts if min-writer < its txn index.
+  Iterations needed = length of the longest abort chain (usually 2-3);
+  convergence to the sequential answer is exact, detected by an unchanged
+  status vector.
+- Equal-key endpoint ordering uses the reference's tiebreak
+  read_end < write_end < write_begin < read_begin (SkipList.cpp:147-177),
+  which makes index-interval overlap equal half-open key-range overlap.
+- Write merge + GC (addConflictRanges :511-523, removeBefore :665-702):
+  committed write ranges override the step function at the batch version in
+  one sorted sweep (coverage = cumsum of begin/end counts), horizon-stale
+  versions clamp to 0 (observationally identical, see cpu.py), equal
+  neighbours coalesce, and two stable-argsort compactions produce the new
+  sorted state. Overflow of the fixed capacity is reported to the host,
+  which grows the state and re-runs the identical batch.
+
+Everything is integer arithmetic: no floats, so determinism does not depend
+on reduction order — a requirement for replayable simulation (SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp
+from jax import lax
+
+from .cpu import ConflictSetCPU  # noqa: F401  (re-exported for fallback wiring)
+from .packing import (
+    INT32_MAX,
+    PAD_WORD,
+    KeyWidthError,
+    PackedBatch,
+    next_pow2,
+    pack_batch,
+)
+from .types import COMMITTED, CONFLICT, TOO_OLD, ConflictBatchResult, TxnConflictInfo
+
+_I32_INF = np.int32(2**31 - 1)
+
+
+def _lexsort(columns, num_keys):
+    """lax.sort with a trailing payload column made part of the key so the
+    order is total and stability is irrelevant (determinism by construction)."""
+    return lax.sort(tuple(columns), num_keys=num_keys, is_stable=False)
+
+
+def _sparse_table(values: jnp.ndarray) -> jnp.ndarray:
+    """(K, C) table: row m holds max over windows [i, min(i + 2^m, C))."""
+    c = values.shape[0]
+    rows = [values]
+    step = 1
+    while step < c:
+        prev = rows[-1]
+        idx = jnp.minimum(jnp.arange(c) + step, c - 1)
+        rows.append(jnp.maximum(prev, prev[idx]))
+        step *= 2
+    return jnp.stack(rows)
+
+
+def _range_max(table: jnp.ndarray, lo: jnp.ndarray, hi: jnp.ndarray) -> jnp.ndarray:
+    """Max over [lo, hi) per row; requires hi > lo."""
+    c = table.shape[1]
+    length = (hi - lo).astype(jnp.int32)
+    m = 31 - lax.clz(jnp.maximum(length, 1))
+    window = jnp.left_shift(jnp.int32(1), m).astype(hi.dtype)
+    left = table[m, jnp.clip(lo, 0, c - 1)]
+    right = table[m, jnp.clip(hi - window, 0, c - 1)]
+    return jnp.maximum(left, right)
+
+
+def _seg_update(tree, pos_lo, pos_hi, vals, n_leaves):
+    """Scatter-min `vals` over leaf ranges [pos_lo, pos_hi) via canonical
+    segment-tree nodes. Fixed log2(2N) masked steps."""
+    logn = (2 * n_leaves).bit_length() - 1
+    l = pos_lo + n_leaves
+    r = pos_hi + n_leaves
+    for _ in range(logn):
+        active = l < r
+        updl = active & ((l & 1) == 1)
+        tree = tree.at[jnp.where(updl, l, 0)].min(jnp.where(updl, vals, _I32_INF))
+        l = l + updl
+        updr = active & ((r & 1) == 1)
+        r = r - updr
+        tree = tree.at[jnp.where(updr, r, 0)].min(jnp.where(updr, vals, _I32_INF))
+        l = l >> 1
+        r = r >> 1
+    return tree
+
+
+def _seg_push(tree_l, n_leaves):
+    """From lazy node values L, build D (min of L over ancestors incl. self)
+    and S (min of L over subtree incl. self). Per-level static slices."""
+    depth = n_leaves.bit_length() - 1  # leaves live at depth `depth`
+    d_arr = tree_l
+    for d in range(1, depth + 1):
+        lo, hi = 1 << d, 1 << (d + 1)
+        parent = d_arr[lo >> 1 : hi >> 1]
+        d_arr = d_arr.at[lo:hi].set(
+            jnp.minimum(tree_l[lo:hi], jnp.repeat(parent, 2))
+        )
+    s_arr = tree_l
+    for d in range(depth - 1, -1, -1):
+        lo, hi = 1 << d, 1 << (d + 1)
+        children = s_arr[2 * lo : 2 * hi]
+        pairmin = jnp.minimum(children[0::2], children[1::2])
+        s_arr = s_arr.at[lo:hi].set(jnp.minimum(tree_l[lo:hi], pairmin))
+    return d_arr, s_arr
+
+
+def _seg_query(d_arr, s_arr, pos_lo, pos_hi, n_leaves):
+    """Min over leaf ranges [pos_lo, pos_hi): canonical nodes c contribute
+    min(S[c], D[parent(c)]). Empty ranges return INF."""
+    logn = (2 * n_leaves).bit_length() - 1
+    size = 2 * n_leaves
+    res = jnp.full(pos_lo.shape, _I32_INF, dtype=jnp.int32)
+    l = pos_lo + n_leaves
+    r = pos_hi + n_leaves
+    for _ in range(logn):
+        active = l < r
+        updl = active & ((l & 1) == 1)
+        li = jnp.clip(l, 1, size - 1)
+        cand_l = jnp.minimum(s_arr[li], d_arr[li >> 1])
+        res = jnp.where(updl, jnp.minimum(res, cand_l), res)
+        l = l + updl
+        updr = active & ((r & 1) == 1)
+        r = r - updr
+        ri = jnp.clip(r, 1, size - 1)
+        cand_r = jnp.minimum(s_arr[ri], d_arr[ri >> 1])
+        res = jnp.where(updr, jnp.minimum(res, cand_r), res)
+        l = l >> 1
+        r = r >> 1
+    return res
+
+
+@partial(jax.jit, static_argnames=())
+def _resolve_kernel(
+    # state
+    hkw, hkl, hv, n,
+    # reads
+    rbw, rbl, rew, rel, rtxn, rsnap,
+    # writes
+    wbw, wbl, wew, wel, wtxn, w_valid,
+    # per-txn + scalars
+    too_old, version, oldest_eff,
+):
+    C, W = hkw.shape
+    R = rbw.shape[0]
+    Wr = wbw.shape[0]
+    T = too_old.shape[0]
+    i32 = jnp.int32
+
+    # ================= Phase 1: read-vs-history =================
+    # Merged sort: history keys (tag 1), read ends (tag 0), read begins
+    # (tag 2). Exclusive cumsum of is_history at a read end yields
+    # #{h < e}; at a read begin, #{h <= b} (equal keys: ends sort before
+    # history, begins after).
+    def col(j):
+        return jnp.concatenate([hkw[:, j], rew[:, j], rbw[:, j]])
+
+    lens1 = jnp.concatenate([hkl, rel, rbl])
+    tags1 = jnp.concatenate(
+        [jnp.full(C, 1, i32), jnp.full(R, 0, i32), jnp.full(R, 2, i32)]
+    )
+    pay1 = jnp.arange(C + 2 * R, dtype=i32)
+    sorted1 = _lexsort(
+        [col(j) for j in range(W)] + [lens1, tags1, pay1], num_keys=W + 3
+    )
+    spay1 = sorted1[-1]
+    is_hist = (spay1 < n).astype(i32)
+    c_excl = jnp.cumsum(is_hist) - is_hist
+    ranks = jnp.zeros(C + 2 * R, dtype=i32).at[spay1].set(c_excl)
+    rank_e = ranks[C : C + R]
+    rank_b = ranks[C + R :]
+
+    table = _sparse_table(hv)
+    hist_max = _range_max(table, rank_b - 1, rank_e)
+    read_conf = (hist_max > rsnap).astype(i32)
+    hist_conf = jnp.zeros(T, dtype=i32).at[rtxn].max(read_conf)
+    base_conf = jnp.maximum(hist_conf, too_old.astype(i32))
+
+    # ================= Phase 2: intra-batch fixed point =================
+    # Endpoint positions with the reference tiebreak:
+    # read_end=0 < write_end=1 < write_begin=2 < read_begin=3.
+    def col2(j):
+        return jnp.concatenate([rew[:, j], wew[:, j], wbw[:, j], rbw[:, j]])
+
+    lens2 = jnp.concatenate([rel, wel, wbl, rbl])
+    tags2 = jnp.concatenate(
+        [jnp.full(R, 0, i32), jnp.full(Wr, 1, i32), jnp.full(Wr, 2, i32),
+         jnp.full(R, 3, i32)]
+    )
+    p_total = 2 * R + 2 * Wr
+    pay2 = jnp.arange(p_total, dtype=i32)
+    sorted2 = _lexsort(
+        [col2(j) for j in range(W)] + [lens2, tags2, pay2], num_keys=W + 3
+    )
+    spay2 = sorted2[-1]
+    pos = jnp.zeros(p_total, dtype=i32).at[spay2].set(jnp.arange(p_total, dtype=i32))
+    q_end = pos[:R]
+    s_end = pos[R : R + Wr]
+    s_begin = pos[R + Wr : R + 2 * Wr]
+    q_begin = pos[R + 2 * Wr :]
+
+    n_leaves = next_pow2(p_total, minimum=2)
+
+    def body(carry):
+        conflict, _, it = carry
+        committed_w = w_valid & (conflict[wtxn] == 0)
+        wval = jnp.where(committed_w, wtxn, _I32_INF).astype(i32)
+        tree = jnp.full(2 * n_leaves, _I32_INF, dtype=i32)
+        tree = _seg_update(tree, s_begin, s_end, wval, n_leaves)
+        d_arr, s_arr = _seg_push(tree, n_leaves)
+        min_writer = _seg_query(d_arr, s_arr, q_begin, q_end, n_leaves)
+        evidence = (min_writer < rtxn).astype(i32)
+        ev_txn = jnp.zeros(T, dtype=i32).at[rtxn].max(evidence)
+        new_conflict = jnp.maximum(base_conf, ev_txn)
+        changed = jnp.any(new_conflict != conflict)
+        return new_conflict, changed, it + 1
+
+    def cond(carry):
+        _, changed, it = carry
+        return changed & (it < T + 2)
+
+    conflict, _, _ = lax.while_loop(
+        cond, body, (base_conf, jnp.array(True), jnp.int32(0))
+    )
+
+    # ================= Phase 3: write merge + GC =================
+    committed_w = w_valid & (conflict[wtxn] == 0)
+    p3 = C + 2 * Wr
+
+    def col3(j):
+        return jnp.concatenate([hkw[:, j], wbw[:, j], wew[:, j]])
+
+    lens3 = jnp.concatenate([hkl, wbl, wel])
+    pay3 = jnp.arange(p3, dtype=i32)
+    sorted3 = _lexsort([col3(j) for j in range(W)] + [lens3, pay3], num_keys=W + 2)
+    skey_w = sorted3[:W]
+    skey_l = sorted3[W]
+    spay3 = sorted3[-1]
+
+    is_h3 = (spay3 < n).astype(i32)
+    wb_idx = jnp.clip(spay3 - C, 0, Wr - 1)
+    we_idx = jnp.clip(spay3 - C - Wr, 0, Wr - 1)
+    is_wb = ((spay3 >= C) & (spay3 < C + Wr) & committed_w[wb_idx]).astype(i32)
+    is_we = ((spay3 >= C + Wr) & committed_w[we_idx]).astype(i32)
+    valid_pt = (is_h3 | is_wb | is_we).astype(jnp.bool_)
+
+    cum_h = jnp.cumsum(is_h3)
+    cum_wb = jnp.cumsum(is_wb)
+    cum_we = jnp.cumsum(is_we)
+
+    same_prev = skey_l[1:] == skey_l[:-1]
+    for j in range(W):
+        same_prev = same_prev & (skey_w[j][1:] == skey_w[j][:-1])
+    same_prev = jnp.concatenate([jnp.zeros(1, dtype=jnp.bool_), same_prev])
+
+    run_id = jnp.cumsum((~same_prev).astype(i32)) - 1
+    iota3 = jnp.arange(p3, dtype=i32)
+    run_last = jnp.zeros(p3, dtype=i32).at[run_id].max(iota3)
+    run_first = jnp.full(p3, p3, dtype=i32).at[run_id].min(iota3)
+    end_idx = run_last[run_id]
+    start_idx = run_first[run_id]
+
+    covered = cum_wb[end_idx] > cum_we[end_idx]
+    old_val = hv[jnp.clip(cum_h[end_idx] - 1, 0, C - 1)]
+    val = jnp.where(covered, version, old_val)
+    val = jnp.where(val < oldest_eff, jnp.int64(0), val)
+
+    # One representative per key: the first valid point of each run.
+    cum_v = jnp.cumsum(valid_pt.astype(i32))
+    prev_cum = jnp.where(start_idx > 0, cum_v[jnp.maximum(start_idx - 1, 0)], 0)
+    first_valid = valid_pt & (cum_v == prev_cum + 1)
+
+    # Compaction 1: dedup to run representatives (stable: key order kept).
+    order1 = jnp.argsort(~first_valid, stable=True)
+    m1 = jnp.sum(first_valid.astype(i32))
+    cw1 = [skey_w[j][order1] for j in range(W)]
+    cl1 = skey_l[order1]
+    cv1 = val[order1]
+    in1 = jnp.arange(p3, dtype=i32) < m1
+
+    # Coalesce equal adjacent values.
+    prev_val = jnp.concatenate([jnp.full(1, -1, dtype=cv1.dtype), cv1[:-1]])
+    keep2 = in1 & ((jnp.arange(p3) == 0) | (cv1 != prev_val))
+    order2 = jnp.argsort(~keep2, stable=True)
+    new_n = jnp.sum(keep2.astype(i32))
+    cw2 = [cw1[j][order2] for j in range(W)]
+    cl2 = cl1[order2]
+    cv2 = cv1[order2]
+
+    live = jnp.arange(C, dtype=i32) < new_n
+    hkw_out = jnp.stack(
+        [jnp.where(live, cw2[j][:C], PAD_WORD) for j in range(W)], axis=1
+    )
+    hkl_out = jnp.where(live, cl2[:C], INT32_MAX)
+    hv_out = jnp.where(live, cv2[:C], jnp.int64(0))
+
+    overflow = new_n > C
+
+    statuses = jnp.where(
+        too_old,
+        jnp.int8(TOO_OLD),
+        jnp.where(conflict > 0, jnp.int8(CONFLICT), jnp.int8(COMMITTED)),
+    )
+    return hkw_out, hkl_out, hv_out, new_n, statuses, overflow
+
+
+class ConflictSetTPU:
+    """Device-resident conflict set with the ConflictSetCPU contract.
+
+    State grows by capacity doubling when a batch would overflow; the kernel
+    is pure (state in, state out), so an overflowing attempt is simply
+    retried after the host re-pads the state — results are identical.
+    """
+
+    def __init__(
+        self,
+        init_version: int = 0,
+        max_key_bytes: int = 32,
+        initial_capacity: int = 1024,
+    ):
+        self.n_words = max(1, (max_key_bytes + 3) // 4)
+        self.capacity = next_pow2(initial_capacity, minimum=64)
+        self.oldest_version = 0
+        # Entry 0 is the empty-key sentinel at init_version (the reference's
+        # skip-list header, SkipList.cpp:497 — baseline for all lookups).
+        hkw = np.full((self.capacity, self.n_words), PAD_WORD, dtype=np.uint32)
+        hkl = np.full(self.capacity, INT32_MAX, dtype=np.int32)
+        hv = np.zeros(self.capacity, dtype=np.int64)
+        hkw[0] = 0
+        hkl[0] = 0
+        hv[0] = init_version
+        self.hkw = jnp.asarray(hkw)
+        self.hkl = jnp.asarray(hkl)
+        self.hv = jnp.asarray(hv)
+        self.n = jnp.int32(1)
+
+    def __len__(self) -> int:
+        return int(self.n)
+
+    def _grow(self, min_capacity: int) -> None:
+        new_cap = next_pow2(min_capacity, minimum=self.capacity * 2)
+        pad = new_cap - self.capacity
+        self.hkw = jnp.concatenate(
+            [self.hkw, jnp.full((pad, self.n_words), PAD_WORD, dtype=jnp.uint32)]
+        )
+        self.hkl = jnp.concatenate(
+            [self.hkl, jnp.full(pad, INT32_MAX, dtype=jnp.int32)]
+        )
+        self.hv = jnp.concatenate([self.hv, jnp.zeros(pad, dtype=jnp.int64)])
+        self.capacity = new_cap
+
+    def resolve_packed(self, version: int, new_oldest_version: int, batch: PackedBatch):
+        oldest_eff = max(self.oldest_version, new_oldest_version)
+        n_writes = int(batch.w_valid.sum())
+        while True:
+            if int(self.n) + 2 * n_writes > self.capacity:
+                self._grow(int(self.n) + 2 * n_writes)
+            out = _resolve_kernel(
+                self.hkw, self.hkl, self.hv, self.n,
+                batch.rbw, batch.rbl, batch.rew, batch.rel, batch.rtxn, batch.rsnap,
+                batch.wbw, batch.wbl, batch.wew, batch.wel, batch.wtxn, batch.w_valid,
+                batch.too_old, jnp.int64(version), jnp.int64(oldest_eff),
+            )
+            hkw, hkl, hv, new_n, statuses, overflow = out
+            if bool(overflow):
+                self._grow(self.capacity * 2)
+                continue
+            self.hkw, self.hkl, self.hv, self.n = hkw, hkl, hv, new_n
+            self.oldest_version = oldest_eff
+            return statuses
+
+    def resolve(
+        self,
+        version: int,
+        new_oldest_version: int,
+        txns: Sequence[TxnConflictInfo],
+    ) -> ConflictBatchResult:
+        batch = pack_batch(txns, self.oldest_version, self.n_words)
+        statuses = self.resolve_packed(version, new_oldest_version, batch)
+        return ConflictBatchResult(
+            [int(s) for s in np.asarray(statuses)[: batch.n_txns]]
+        )

@@ -1,0 +1,176 @@
+"""Differential tests: ConflictSetTPU vs the CPU oracle, bit-for-bit.
+
+This is the BASELINE.json contract: identical abort sets between the TPU
+kernel and the reference semantics under randomized batches, including
+sliding-window GC, tooOld, intra-batch chains and capacity growth.
+"""
+
+import random
+
+import pytest
+
+from foundationdb_tpu.kv.keys import KeyRange, key_after
+from foundationdb_tpu.resolver import (
+    COMMITTED,
+    CONFLICT,
+    TOO_OLD,
+    ConflictSetCPU,
+    TxnConflictInfo,
+)
+from foundationdb_tpu.resolver.tpu import ConflictSetTPU
+
+
+def txn(snap, reads=(), writes=()):
+    return TxnConflictInfo(
+        read_snapshot=snap,
+        read_ranges=[KeyRange(b, e) for b, e in reads],
+        write_ranges=[KeyRange(b, e) for b, e in writes],
+    )
+
+
+def both():
+    return ConflictSetCPU(), ConflictSetTPU(initial_capacity=64)
+
+
+def check(cpu, tpu, version, new_oldest, txns):
+    want = cpu.resolve(version, new_oldest, txns).statuses
+    got = tpu.resolve(version, new_oldest, txns).statuses
+    assert got == want, f"v={version}: tpu={got} cpu={want}\ntxns={txns}"
+    return got
+
+
+class TestKernelBasics:
+    def test_blind_write_then_conflicting_read(self):
+        cpu, tpu = both()
+        check(cpu, tpu, 10, 0, [txn(5, writes=[(b"a", b"b")])])
+        s = check(cpu, tpu, 20, 0, [txn(5, reads=[(b"a", b"b")])])
+        assert s == [CONFLICT]
+        s = check(cpu, tpu, 30, 0, [txn(25, reads=[(b"a", b"b")])])
+        assert s == [COMMITTED]
+
+    def test_boundary_touch(self):
+        cpu, tpu = both()
+        check(cpu, tpu, 10, 0, [txn(5, writes=[(b"m", b"n")])])
+        s = check(
+            cpu, tpu, 20, 0,
+            [txn(5, reads=[(b"a", b"m")]), txn(5, reads=[(b"n", b"z")])],
+        )
+        assert s == [COMMITTED, COMMITTED]
+
+    def test_single_key(self):
+        cpu, tpu = both()
+        check(cpu, tpu, 10, 0, [txn(5, writes=[(b"k", key_after(b"k"))])])
+        s = check(cpu, tpu, 20, 0, [txn(5, reads=[(b"k", key_after(b"k"))])])
+        assert s == [CONFLICT]
+
+    def test_too_old(self):
+        cpu, tpu = both()
+        check(cpu, tpu, 10, 8, [txn(5, writes=[(b"a", b"b")])])
+        s = check(cpu, tpu, 20, 8, [txn(7, reads=[(b"q", b"r")])])
+        assert s == [TOO_OLD]
+
+    def test_intra_batch_chain(self):
+        cpu, tpu = both()
+        s = check(
+            cpu, tpu, 10, 0,
+            [
+                txn(5, writes=[(b"k", b"l")]),
+                txn(5, reads=[(b"k", b"l")], writes=[(b"m", b"n")]),
+                txn(5, reads=[(b"m", b"n")]),
+            ],
+        )
+        assert s == [COMMITTED, CONFLICT, COMMITTED]
+
+    def test_long_abort_chain(self):
+        """Chain of depth 8: txn i reads what txn i-1 wrote; alternating
+        commit/abort pattern exercises the fixed-point iteration."""
+        cpu, tpu = both()
+        txns = [txn(5, writes=[(b"c0", b"c1")])]
+        for i in range(1, 8):
+            txns.append(
+                txn(
+                    5,
+                    reads=[(f"c{i-1}".encode(), f"c{i-1}\x01".encode())],
+                    writes=[(f"c{i}".encode(), f"c{i}\x01".encode())],
+                )
+            )
+        s = check(cpu, tpu, 10, 0, txns)
+        assert s == [COMMITTED, CONFLICT, COMMITTED, CONFLICT] * 2
+
+    def test_empty_batch_and_write_only(self):
+        cpu, tpu = both()
+        check(cpu, tpu, 10, 0, [])
+        check(cpu, tpu, 20, 0, [txn(0, writes=[(b"w", b"x")])])
+
+    def test_capacity_growth(self):
+        cpu = ConflictSetCPU()
+        tpu = ConflictSetTPU(initial_capacity=64)
+        keys = [b"k%04d" % i for i in range(300)]
+        txns = [txn(0, writes=[(k, key_after(k))]) for k in keys]
+        check(cpu, tpu, 10, 0, txns)
+        reads = [txn(5, reads=[(k, key_after(k))]) for k in keys]
+        s = check(cpu, tpu, 20, 0, reads)
+        assert s == [CONFLICT] * 300
+
+
+def random_key(rng, depth):
+    alphabet = [b"a", b"b", b"c", b"d", b"\x00", b"\xff", b"e"]
+    return b"".join(rng.choice(alphabet) for _ in range(rng.randint(1, depth)))
+
+
+def random_range(rng, depth=3):
+    a, b = random_key(rng, depth), random_key(rng, depth)
+    if a == b:
+        b = key_after(a)
+    return KeyRange(min(a, b), max(a, b))
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_differential_randomized(seed):
+    rng = random.Random(seed * 7919)
+    cpu = ConflictSetCPU()
+    tpu = ConflictSetTPU(initial_capacity=64)
+    version = 0
+    for batch_i in range(10):
+        version += rng.randint(1, 100)
+        new_oldest = max(0, version - 150)
+        txns = []
+        for _ in range(rng.randint(1, 15)):
+            snap = max(0, version - rng.randint(1, 220))
+            reads = [random_range(rng) for _ in range(rng.randint(0, 3))]
+            writes = [random_range(rng) for _ in range(rng.randint(0, 3))]
+            txns.append(TxnConflictInfo(snap, reads, writes))
+        check(cpu, tpu, version, new_oldest, txns)
+    # The surviving step functions must agree wherever observable.
+    for _ in range(50):
+        r = random_range(rng)
+        snap = version - rng.randint(0, 140)
+        probe = [TxnConflictInfo(snap, [r], [])]
+        version += 1
+        check(cpu, tpu, version, max(0, version - 150), probe)
+
+
+def test_sliding_window_steady_state():
+    """Config-5 shape in miniature: continuous microbatches with GC; the
+    state must stay bounded and exact."""
+    rng = random.Random(424242)
+    cpu = ConflictSetCPU()
+    tpu = ConflictSetTPU(initial_capacity=64)
+    version = 0
+    sizes = []
+    for _ in range(30):
+        version += 10
+        txns = []
+        for _ in range(8):
+            snap = version - rng.randint(1, 60)
+            txns.append(
+                TxnConflictInfo(
+                    max(0, snap),
+                    [random_range(rng, 4)],
+                    [random_range(rng, 4)],
+                )
+            )
+        check(cpu, tpu, version, max(0, version - 50), txns)
+        sizes.append(len(tpu))
+    # GC keeps the state from growing without bound.
+    assert max(sizes[-10:]) <= max(sizes) <= 2000
