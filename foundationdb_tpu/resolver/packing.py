@@ -124,8 +124,9 @@ class PositionedBatch:
     """
 
     packed: PackedBatch
-    # sorted endpoints, padded to P2
-    sew: np.ndarray     # (P2, W) uint64 sorted endpoint words
+    # sorted endpoints, padded to P2; WORD-MAJOR (W, P2) — TPU pads tiny
+    # minor dimensions to 128 lanes, so the large axis must be minor
+    sew: np.ndarray     # (W, P2) uint64 sorted endpoint words
     sel: np.ndarray     # (P2,) int32 sorted lengths
     stag: np.ndarray    # (P2,) int32 tags: 0=re, 1=we, 2=wb, 3=rb (pad: 0)
     wsrc: np.ndarray    # (P2,) int32 write row for we/wb entries, else 0
@@ -177,11 +178,11 @@ def position_batch(packed: PackedBatch) -> PositionedBatch:
     s_begin = inv[R + Wr : R + 2 * Wr]
     q_begin = inv[R + 2 * Wr :]
 
-    sew = np.full((P2, W), PAD_WORD, dtype=np.uint64)
+    sew = np.full((W, P2), PAD_WORD, dtype=np.uint64)
     sel = np.full(P2, INT32_MAX, dtype=np.int32)
     stag = np.zeros(P2, dtype=np.int32)
     wsrc = np.zeros(P2, dtype=np.int32)
-    sew[:P] = words[order]
+    sew[:, :P] = words[order].T
     sel[:P] = lens[order]
     stag[:P] = tags[order]
     src = np.zeros(P, dtype=np.int32)
@@ -191,7 +192,9 @@ def position_batch(packed: PackedBatch) -> PositionedBatch:
 
     same_ep = np.zeros(P2, dtype=bool)
     if P > 1:
-        eq = np.all(sew[1:P] == sew[: P - 1], axis=1) & (sel[1:P] == sel[: P - 1])
+        eq = np.all(sew[:, 1:P] == sew[:, : P - 1], axis=0) & (
+            sel[1:P] == sel[: P - 1]
+        )
         same_ep[1:P] = eq
 
     is_wb = (stag[:P] == TAG_WB).astype(np.int64)

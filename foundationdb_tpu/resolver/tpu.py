@@ -4,27 +4,33 @@ Replaces the reference's per-range skip-list walk (SkipList::detectConflicts,
 fdbserver/SkipList.cpp:524-553, driven by ConflictBatch::detectConflicts
 :1163-1208) with fixed-shape tensor passes sized for 64K-1M transaction
 batches, designed TPU-first around what actually compiles and runs fast on
-the hardware (all numbers measured on a v5 lite chip, see PositionedBatch in
-packing.py):
+the hardware (all numbers measured on a v5 lite chip):
 
-- gathers, scatters and branchless binary searches compile in ~1 s and run
-  in ~0.05 ms at 1M elements — the kernel is built almost entirely from
-  them;
+- 1-D gathers, scatters and branchless binary searches compile in ~1 s and
+  run in ~0.05 ms at 1M elements — the kernel is built almost entirely from
+  them. Key tensors are WORD-MAJOR (W, N): a (N, 4) layout puts 4 in the
+  lane dimension and TPU pads it to 128 lanes (32x memory and gather
+  waste — measured 242 ms vs ~7 ms for the same searches), so every array
+  keeps its large axis minor.
 - XLA's TPU variadic sort runs fast but takes minutes to COMPILE for
-  multi-word keys, and lax.cumsum takes ~17 s — so the kernel contains no
-  device sort (the host lexsorts batch endpoints during packing, mirroring
-  the reference's sortPoints; the device merges them against the resident
-  sorted history by binary search) and no lax.cumsum (prefix sums are
-  unrolled log-step Hillis-Steele adds, ~20 cheap fused ops).
+  multi-word keys (405 s measured), and lax.cumsum takes ~17 s — so the
+  kernel contains no device sort (the host lexsorts batch endpoints during
+  packing, mirroring the reference's sortPoints; the device merges them
+  against the resident sorted history by binary search) and no lax.cumsum
+  (prefix sums are unrolled log-step Hillis-Steele adds).
+- One binary search total: lb = #history < key. ub = #history <= key
+  follows from lb plus one equality probe (history keys are unique), and
+  the endpoint-rank-of-history lbB = #endpoints < hist follows from ub by
+  the merge duality  #B < A[j] = #{p : ub[p] <= j}  — a scatter-count and
+  a prefix sum instead of two more searches.
 
 Phases (semantics identical to the CPU oracle in cpu.py):
 
 1. Read-vs-history (CheckMax, SkipList.cpp:755-837): history is a step
-   function version(x) held on device as sorted packed-key tensors. Ranks of
-   every batch endpoint in the history come from two branchless binary
-   searches (#h < key and #h <= key); the max version over each read range
-   comes from an O(C) subtree-max segment tree built with static slices and
-   queried with an unrolled canonical-node walk.
+   function version(x) held on device as sorted packed-key tensors; the max
+   version over each read range comes from an O(C) subtree-max segment tree
+   built with static slices and queried with an unrolled canonical-node
+   walk.
 2. Intra-batch (checkIntraBatchConflicts, SkipList.cpp:1133-1158): the
    sequential "reads of txn t vs writes of earlier still-committed txns"
    rule is the unique fixed point of
@@ -37,23 +43,23 @@ Phases (semantics identical to the CPU oracle in cpu.py):
      case A — the write BEGINS strictly inside the read's span: range-min
        over a sparse table of writer indices in write-begin position order
        (rank compression precomputed on host);
-     case B — the write COVERS the read's begin position: scatter-min of
-       writer indices onto precomputed canonical segment-tree nodes of each
-       write span, then a stabbing query = min over the read-begin leaf's
-       ancestors (one 2-D gather).
+     case B — the write COVERS the read's begin position: one flat
+       scatter-min of writer indices onto precomputed canonical
+       segment-tree nodes of each write span, then a stabbing query = min
+       over the read-begin leaf's ancestors (log P 1-D gathers).
    The loop body is ~1 scatter + gathers; everything shape-dependent is
    hoisted out of the loop.
 3. Write merge + GC (addConflictRanges :511-523, removeBefore :665-702):
-   merge-by-rank: endpoint merged position = index + (#h <= key), history
-   merged position = index + (#endpoints < key) — unique positions, two
-   unique-destination scatters build the merged sequence. Committed write
-   coverage (cumsum of begin/end flags) overrides the step function at the
-   batch version, horizon-stale versions clamp to 0 (observationally
-   identical, see cpu.py), equal neighbours coalesce, and two scatter
-   compactions (unique destinations; dump-slot writes use .max so the
-   result is scatter-order independent, hence deterministic) produce the
-   new sorted state. Overflow of the fixed capacity is reported to the
-   host, which grows the state and re-runs the identical batch.
+   merge-by-rank: endpoint merged position = index + ub, history merged
+   position = index + lbB — unique positions, two unique-destination
+   scatters build the merged sequence. Committed write coverage (prefix
+   sums of begin/end flags) overrides the step function at the batch
+   version, horizon-stale versions clamp to 0 (observationally identical,
+   see cpu.py), equal neighbours coalesce, and two scatter compactions
+   (unique destinations; dump-slot writes use .max so the result is
+   scatter-order independent, hence deterministic) produce the new sorted
+   state. Overflow of the fixed capacity is reported to the host, which
+   grows the state and re-runs the identical batch.
 
 Batches of unbounded size are CHUNKED (resolve() → resolve_packed() per
 chunk): all transactions of one resolve share a commit version, and since
@@ -149,7 +155,7 @@ def _build_max_tree(leaves: jnp.ndarray) -> jnp.ndarray:
 def _tree_range_max(s: jnp.ndarray, lo: jnp.ndarray, hi: jnp.ndarray):
     """Vectorized range-max over [lo, hi) against a subtree-max tree.
     Standard iterative canonical-node walk, unrolled log C times; every step
-    is mask arithmetic + one gather. Empty ranges return 0."""
+    is mask arithmetic + one 1-D gather. Empty ranges return 0."""
     c = s.shape[0] // 2
     res = jnp.zeros(lo.shape, dtype=s.dtype)
     l = (lo + c).astype(jnp.int32)
@@ -167,10 +173,10 @@ def _tree_range_max(s: jnp.ndarray, lo: jnp.ndarray, hi: jnp.ndarray):
     return res
 
 
-def _canonical_nodes(pos_lo: jnp.ndarray, pos_hi: jnp.ndarray, n_leaves: int):
-    """Per-interval canonical segment-tree nodes over n_leaves (power of two)
-    leaves: (N, 2*steps) int32, 0 marks an unused slot (node 0 is never a
-    real node — root is 1). Pure integer arithmetic, computed once."""
+def _canonical_nodes_flat(pos_lo: jnp.ndarray, pos_hi: jnp.ndarray, n_leaves: int):
+    """Canonical segment-tree node ids of each [pos_lo, pos_hi) interval,
+    flattened to 1-D (2*steps blocks of N), 0 marking unused slots (node 0
+    is never a real node — root is 1). Pure integer arithmetic."""
     steps = n_leaves.bit_length()
     l = (pos_lo + n_leaves).astype(jnp.int32)
     r = (pos_hi + n_leaves).astype(jnp.int32)
@@ -185,7 +191,7 @@ def _canonical_nodes(pos_lo: jnp.ndarray, pos_hi: jnp.ndarray, n_leaves: int):
         cols.append(jnp.where(tr, r, 0))
         l = l >> 1
         r = r >> 1
-    return jnp.stack(cols, axis=1)
+    return jnp.concatenate(cols), 2 * steps
 
 
 def _min_table(values: jnp.ndarray) -> jnp.ndarray:
@@ -213,30 +219,37 @@ def _table_range_min(table: jnp.ndarray, lo: jnp.ndarray, hi: jnp.ndarray):
     return jnp.where(hi > lo, jnp.minimum(left, right), _I32_INF)
 
 
-def _key_lt(hw, hl, idx, qw, ql, or_equal: bool):
-    """hist[idx] < query (or <=), lexicographic over W big-endian u64 words
-    then byte length. One row-gather + ~3 ops per word."""
-    rows = hw[idx]  # (Q, W)
-    rl = hl[idx]
+def _probe_lt(hw, hl, idx, qw, ql, or_equal: bool):
+    """hist[idx] < query (or <=): lexicographic over W big-endian u64 word
+    rows (word-major (W, C)) then byte length. W+1 1-D gathers."""
     res = jnp.zeros(idx.shape, dtype=bool)
     eq = jnp.ones(idx.shape, dtype=bool)
-    for j in range(hw.shape[1]):
-        res = res | (eq & (rows[:, j] < qw[:, j]))
-        eq = eq & (rows[:, j] == qw[:, j])
-    res = res | (eq & (rl < ql))
+    for j in range(hw.shape[0]):
+        h = hw[j][idx]
+        res = res | (eq & (h < qw[j]))
+        eq = eq & (h == qw[j])
+    hlen = hl[idx]
+    res = res | (eq & (hlen < ql))
     if or_equal:
-        res = res | (eq & (rl == ql))
+        res = res | (eq & (hlen == ql))
     return res
 
 
-def _branchless_rank(hw, hl, qw, ql, or_equal: bool):
-    """#entries of the sorted (power-of-two, +inf padded) array (hw, hl)
-    strictly less than (or <=) each query key. log C unrolled steps."""
-    c = hw.shape[0]
+def _probe_eq(hw, hl, idx, qw, ql):
+    eq = hl[idx] == ql
+    for j in range(hw.shape[0]):
+        eq = eq & (hw[j][idx] == qw[j])
+    return eq
+
+
+def _lower_rank(hw, hl, qw, ql):
+    """#entries of the sorted (power-of-two, +inf padded, word-major) array
+    strictly less than each query key. log C unrolled probe steps."""
+    c = hw.shape[1]
     pos = jnp.zeros(ql.shape, dtype=jnp.int32)
     s = c // 2
     while s >= 1:
-        take = _key_lt(hw, hl, pos + (s - 1), qw, ql, or_equal)
+        take = _probe_lt(hw, hl, pos + (s - 1), qw, ql, or_equal=False)
         pos = pos + jnp.where(take, s, 0)
         s //= 2
     return pos
@@ -244,9 +257,9 @@ def _branchless_rank(hw, hl, qw, ql, or_equal: bool):
 
 @jax.jit
 def _resolve_kernel(
-    # state (sorted ascending; rows >= n are PAD)
+    # state (sorted ascending; columns >= n are PAD); word-major keys
     hkw, hkl, hv, n,
-    # sorted endpoints (P2-padded) + positions (from the host sort)
+    # sorted endpoints (P2-padded, word-major) + positions (host sort)
     sew, sel, stag, wsrc, same_ep,
     q_end, s_end, s_begin, q_begin,
     lo_r, hi_r, perm_w,
@@ -255,16 +268,19 @@ def _resolve_kernel(
     # scalars
     version, oldest_eff,
 ):
-    C, W = hkw.shape
-    P2 = sew.shape[0]
-    R = rtxn.shape[0]
-    Wr = wtxn.shape[0]
+    W, C = hkw.shape
+    P2 = sew.shape[1]
     T = too_old.shape[0]
     i32 = jnp.int32
+    sew_rows = [sew[j] for j in range(W)]
 
-    # ============ Ranks: sorted endpoints vs sorted history ============
-    lb = _branchless_rank(hkw, hkl, sew, sel, or_equal=False)  # #h < key
-    ub = _branchless_rank(hkw, hkl, sew, sel, or_equal=True)   # #h <= key
+    # ============ Ranks: one binary search + algebraic derivations ============
+    lb = _lower_rank(hkw, hkl, sew_rows, sel)                  # #h < key
+    eq = _probe_eq(hkw, hkl, jnp.clip(lb, 0, C - 1), sew_rows, sel)
+    is_pad_q = sel == INT32_MAX
+    ub = jnp.where(is_pad_q, C, lb + eq)                        # #h <= key
+    # (pad queries count all pad history rows so merged positions of pads
+    # stay collision-free; see phase 3.)
 
     # ============ Phase 1: read-vs-history ============
     rank_e = lb[q_end]    # #h < read_end
@@ -278,9 +294,8 @@ def _resolve_kernel(
     # ============ Phase 2: intra-batch fixed point ============
     n_leaves = P2
     k_levels = n_leaves.bit_length()
-    wnodes = _canonical_nodes(s_begin, s_end, n_leaves)
-    shifts = jnp.arange(k_levels, dtype=i32)
-    anc = (q_begin[:, None] + n_leaves) >> shifts[None, :]
+    wnodes, n_blocks = _canonical_nodes_flat(s_begin, s_end, n_leaves)
+    Wr = wtxn.shape[0]
 
     def body(carry):
         conflict, _, it = carry
@@ -289,9 +304,13 @@ def _resolve_kernel(
         # Case A: writes beginning strictly inside the read's span.
         case_a = _table_range_min(_min_table(wval[perm_w]), lo_r, hi_r)
         # Case B: writes covering the read's begin position.
+        wval_rep = jnp.broadcast_to(wval, (n_blocks, Wr)).reshape(-1)
         tree_l = jnp.full(2 * n_leaves, _I32_INF, dtype=i32)
-        tree_l = tree_l.at[wnodes].min(wval[:, None])
-        stab = jnp.min(tree_l[anc], axis=1)
+        tree_l = tree_l.at[wnodes].min(wval_rep)
+        leaf = q_begin + n_leaves
+        stab = jnp.full(leaf.shape, _I32_INF, dtype=i32)
+        for k in range(k_levels):
+            stab = jnp.minimum(stab, tree_l[leaf >> k])
         min_writer = jnp.minimum(case_a, stab)
         evidence = (min_writer < rtxn).astype(i32)
         ev_txn = jnp.zeros(T, dtype=i32).at[rtxn].max(evidence)
@@ -311,12 +330,13 @@ def _resolve_kernel(
     committed_w = w_valid & (conflict[wtxn] == 0)
     N3 = C + P2
 
-    # #endpoints strictly < each history key (for history merged positions).
-    lbB = _branchless_rank(sew, sel, hkw, hkl, or_equal=False)
+    # Merge duality: #endpoints < hist[j] = #{p : ub[p] <= j}. One
+    # scatter-count over ub plus a prefix sum replaces a third search.
+    cnt_ub = jnp.zeros(C + 1, dtype=i32).at[jnp.minimum(ub, C)].add(1)
+    lbB = _cumsum_i32(cnt_ub[:C])
     posA = jnp.arange(C, dtype=i32) + lbB          # history -> merged
     posB = jnp.arange(P2, dtype=i32) + ub          # endpoints -> merged
-    # Ties are history-first (ub counts h <= key), so merged positions are a
-    # permutation of [0, N3).
+    # Ties are history-first, so merged positions are a permutation of N3.
 
     is_h_m = jnp.zeros(N3, dtype=i32).at[posA].set((jnp.arange(C) < n).astype(i32))
     committed_ep = committed_w[wsrc]
@@ -331,14 +351,11 @@ def _resolve_kernel(
     # endpoints sort after their equal history entry, so a history element is
     # never equal to its merged predecessor; an endpoint's predecessor is the
     # previous endpoint iff their merged positions are adjacent, else it is
-    # history entry ub-1 (the greatest <= key).
+    # history entry ub-1 (equal to the key iff eq).
     prev_is_ep = jnp.concatenate(
         [jnp.zeros(1, dtype=bool), posB[1:] == posB[:-1] + 1]
     )
-    eq_hist = _key_lt(hkw, hkl, jnp.clip(ub - 1, 0, C - 1), sew, sel, True) & ~_key_lt(
-        hkw, hkl, jnp.clip(ub - 1, 0, C - 1), sew, sel, False
-    )  # hist[ub-1] == key
-    same_prev_ep = jnp.where(prev_is_ep, same_ep, eq_hist & (ub > 0))
+    same_prev_ep = jnp.where(prev_is_ep, same_ep, eq & (ub > 0))
     same_prev_m = jnp.zeros(N3, dtype=bool).at[posB].set(same_prev_ep)
 
     cum_h = _cumsum_i32(is_h_m)
@@ -390,16 +407,21 @@ def _resolve_kernel(
     hv_new = jnp.zeros(C + 1, dtype=jnp.int64).at[dest2].max(cval)[:C]
 
     # Materialize keys for the new state by gathering from history or the
-    # sorted endpoint array, selected per row.
+    # sorted endpoint rows, selected per entry (all 1-D gathers).
     from_hist = src2 < C
     hidx = jnp.clip(src2, 0, C - 1)
     eidx = jnp.clip(src2 - C, 0, P2 - 1)
-    key_rows = jnp.where(from_hist[:, None], hkw[hidx], sew[eidx])
-    len_rows = jnp.where(from_hist, hkl[hidx], sel[eidx])
-
     live = jnp.arange(C, dtype=i32) < new_n
-    hkw_out = jnp.where(live[:, None], key_rows, PAD_WORD)
-    hkl_out = jnp.where(live, len_rows, INT32_MAX)
+    out_rows = [
+        jnp.where(
+            live, jnp.where(from_hist, hkw[j][hidx], sew[j][eidx]), PAD_WORD
+        )
+        for j in range(W)
+    ]
+    hkw_out = jnp.stack(out_rows)  # (W, C): large axis minor
+    hkl_out = jnp.where(
+        live, jnp.where(from_hist, hkl[hidx], sel[eidx]), INT32_MAX
+    )
     hv_out = jnp.where(live, hv_new, jnp.int64(0))
 
     overflow = new_n > C
@@ -438,10 +460,10 @@ class ConflictSetTPU:
         self.oldest_version = 0
         # Entry 0 is the empty-key sentinel at init_version (the reference's
         # skip-list header, SkipList.cpp:497 — baseline for all lookups).
-        hkw = np.full((self.capacity, self.n_words), PAD_WORD, dtype=np.uint64)
+        hkw = np.full((self.n_words, self.capacity), PAD_WORD, dtype=np.uint64)
         hkl = np.full(self.capacity, INT32_MAX, dtype=np.int32)
         hv = np.zeros(self.capacity, dtype=np.int64)
-        hkw[0] = 0
+        hkw[:, 0] = 0
         hkl[0] = 0
         hv[0] = init_version
         self.hkw = jnp.asarray(hkw)
@@ -452,11 +474,25 @@ class ConflictSetTPU:
     def __len__(self) -> int:
         return int(self.n)
 
+    def entries(self) -> list[tuple[bytes, int]]:
+        """Host copy of the live step function (for tests/debugging)."""
+        n = int(self.n)
+        hkw = np.asarray(self.hkw)[:, :n]
+        hkl = np.asarray(self.hkl)[:n]
+        hv = np.asarray(self.hv)[:n]
+        out = []
+        for i in range(n):
+            kl = int(hkl[i])
+            b = b"".join(int(w).to_bytes(8, "big") for w in hkw[:, i])[:kl]
+            out.append((b, int(hv[i])))
+        return out
+
     def _grow(self, min_capacity: int) -> None:
         new_cap = next_pow2(min_capacity, minimum=self.capacity * 2)
         pad = new_cap - self.capacity
         self.hkw = jnp.concatenate(
-            [self.hkw, jnp.full((pad, self.n_words), PAD_WORD, dtype=jnp.uint64)]
+            [self.hkw, jnp.full((self.n_words, pad), PAD_WORD, dtype=jnp.uint64)],
+            axis=1,
         )
         self.hkl = jnp.concatenate(
             [self.hkl, jnp.full(pad, INT32_MAX, dtype=jnp.int32)]
