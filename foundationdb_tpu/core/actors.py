@@ -130,12 +130,12 @@ class PromiseStream(Generic[T]):
 
     def pop(self) -> Future:
         if self._queue:
-            f = ready_future(self._queue.popleft())
-            # If the popping actor dies before consuming, the value returns
-            # to the front of the queue (the reference keeps unconsumed
-            # values in the FutureStream queue across waiter cancellation).
-            f._abandon_cb = lambda fut: self._queue.appendleft(fut._value)
-            return f
+            # A queued value is consumed at pop() time: awaiting an already-
+            # ready future never suspends the actor, so there is no window in
+            # which cancellation could abandon it. (A popper that parks the
+            # ready future and dies at some other await forfeits the value —
+            # same as the reference, where popping dequeues immediately.)
+            return ready_future(self._queue.popleft())
         if self._closed is not None:
             p = Promise()
             p.send_error(self._closed)

@@ -71,6 +71,11 @@ class ServerKnobs(Knobs):
         # batch is padded up to the next bucket to avoid XLA recompiles.
         init("TPU_BATCH_BUCKETS", (256, 1024, 4096, 16384, 65536))
         init("TPU_HISTORY_CAPACITY", 1 << 20)
+        # Chunk caps for resolve(): one resolve is split into chunks of at
+        # most this many transactions / total conflict ranges so the set of
+        # jit-compiled shapes stays bounded (see resolver/tpu.py _chunks).
+        init("TPU_MAX_CHUNK_TXNS", 65536)
+        init("TPU_MAX_CHUNK_RANGES", 1 << 19)
         # Storage (ref: fdbserver/Knobs.cpp storage section)
         init("STORAGE_DURABILITY_LAG_VERSIONS", 5 * 1_000_000)
         init("STORAGE_COMMIT_INTERVAL", 0.5)

@@ -218,15 +218,13 @@ def pack_batch(
     txns: Sequence[TxnConflictInfo],
     oldest_version: int,
     n_words: int,
-    txn_offset: int = 0,
 ) -> PackedBatch:
     """Flatten a transaction batch into padded tensors.
 
     tooOld transactions (read_snapshot < oldestVersion with read ranges)
     contribute no ranges, exactly like the reference's addTransaction
-    (fdbserver/SkipList.cpp:979-987). ``txn_offset`` shifts nothing — txn
-    indices are always batch-local — but is kept for chunked callers that
-    want the statuses array length to match their slice.
+    (fdbserver/SkipList.cpp:979-987). Txn indices are always batch-local;
+    chunked callers slice statuses by each chunk's n_txns.
     """
     n_txns = len(txns)
     too_old_l = [

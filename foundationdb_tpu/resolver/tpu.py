@@ -65,8 +65,11 @@ Batches of unbounded size are CHUNKED (resolve() → resolve_packed() per
 chunk): all transactions of one resolve share a commit version, and since
 every snapshot precedes that version, a read conflicting with an earlier
 chunk's committed write via merged history is exactly the intra-batch rule —
-so chunked resolution is bit-identical to one giant batch while bounding
-HBM and the set of compiled shapes (SURVEY.md §7 "batch-size bucketing").
+so chunked resolution yields observationally identical statuses and final
+state to one giant batch (intermediate chunks clamp GC against the pre-batch
+horizon, so interior entry counts and growth timing can differ) while
+bounding HBM and the set of compiled shapes (SURVEY.md §7 "batch-size
+bucketing").
 
 Everything is integer arithmetic: no floats, so determinism does not depend
 on reduction order — a requirement for replayable simulation (SURVEY.md §7).
@@ -507,8 +510,12 @@ class ConflictSetTPU:
         oldest_eff = max(self.oldest_version, new_oldest_version)
         n_writes = int(batch.w_valid.sum())
         while True:
-            if int(self.n) + 2 * n_writes > self.capacity:
-                self._grow(int(self.n) + 2 * n_writes)
+            # ">=" keeps at least one +inf pad column in the history at kernel
+            # entry even for read-only batches at n == capacity: _lower_rank's
+            # branchless search saturates at C-1, so a key above every live
+            # entry needs a pad entry to rank against (ADVICE r2 high).
+            if int(self.n) + 2 * n_writes >= self.capacity:
+                self._grow(int(self.n) + 2 * n_writes + 1)
             out = _resolve_kernel(
                 self.hkw, self.hkl, self.hv, self.n,
                 pb.sew, pb.sel, pb.stag, pb.wsrc, pb.same_ep,
@@ -537,8 +544,8 @@ class ConflictSetTPU:
         module docstring."""
         from ..core.knobs import SERVER_KNOBS
 
-        max_txns = getattr(SERVER_KNOBS, "TPU_MAX_CHUNK_TXNS", 65536)
-        max_ranges = getattr(SERVER_KNOBS, "TPU_MAX_CHUNK_RANGES", 1 << 19)
+        max_txns = SERVER_KNOBS.TPU_MAX_CHUNK_TXNS
+        max_ranges = SERVER_KNOBS.TPU_MAX_CHUNK_RANGES
         out: list[list[TxnConflictInfo]] = []
         cur: list[TxnConflictInfo] = []
         cur_ranges = 0
@@ -581,10 +588,7 @@ class ConflictSetTPU:
         from ..core.knobs import SERVER_KNOBS
 
         if shapes is None:
-            shapes = [
-                (b, 5 * b, 2 * b)
-                for b in getattr(SERVER_KNOBS, "TPU_BATCH_BUCKETS", (256,))
-            ]
+            shapes = [(b, 5 * b, 2 * b) for b in SERVER_KNOBS.TPU_BATCH_BUCKETS]
         saved = (self.hkw, self.hkl, self.hv, self.n, self.oldest_version)
         for (t, r, w) in shapes:
             batch = _dummy_batch(t, r, w, self.n_words)

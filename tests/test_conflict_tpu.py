@@ -102,6 +102,47 @@ class TestKernelBasics:
         check(cpu, tpu, 10, 0, [])
         check(cpu, tpu, 20, 0, [txn(0, writes=[(b"w", b"x")])])
 
+    def test_read_only_at_full_capacity(self):
+        """Regression (ADVICE r2 high): with the history filled to exactly
+        capacity, _lower_rank's branchless search saturates at C-1, so a read
+        range above the top key ranked wrongly (spurious CONFLICT / missed
+        conflict + corrupt merge positions). The counts below are tuned so
+        that under the pre-fix '>' growth check the state lands at
+        new_n == capacity == 64 with no growth, and the read-only probe then
+        runs against a padless history; the '>=' fix instead guarantees a
+        pad column at every kernel entry (asserted as an invariant)."""
+        cpu = ConflictSetCPU()
+        tpu = ConflictSetTPU(initial_capacity=64)
+        version = 0
+        # 60 adjacent ranges at distinct versions: first write adds 2 step
+        # entries, each later one adds 1 -> n = 2 + 60 = 62 entries.
+        keys = [bytes([1, i]) for i in range(61)]
+        for i in range(len(keys) - 1):
+            version += 1
+            t = txn(version - 1, writes=[(keys[i], keys[i + 1])])
+            check(cpu, tpu, version, 0, [t])
+            assert int(tpu.n) < tpu.capacity
+        # One disjoint write adds 2 fresh entries: pre-fix, 62 + 2*1 was not
+        # '> 64' so no growth happened and new_n hit 64 == capacity.
+        version += 1
+        check(cpu, tpu, version, 0, [txn(version - 1, writes=[(b"\xf0", b"\xf8")])])
+        assert int(tpu.n) == 64
+        assert int(tpu.n) < tpu.capacity
+        # Read-only probes (no writes => no growth headroom beyond the
+        # guaranteed pad column): above the top history key at snapshots that
+        # must commit, inside the high write so it must conflict, above it
+        # again at an old snapshot so it must commit.
+        version += 1
+        s = check(
+            cpu, tpu, version, 0,
+            [
+                txn(version - 1, reads=[(b"\xfe", b"\xff")]),
+                txn(0, reads=[(b"\xf4", b"\xf5")]),
+                txn(0, reads=[(b"\xfe", b"\xff")]),
+            ],
+        )
+        assert s == [COMMITTED, CONFLICT, COMMITTED]
+
     def test_capacity_growth(self):
         cpu = ConflictSetCPU()
         tpu = ConflictSetTPU(initial_capacity=64)
