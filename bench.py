@@ -184,14 +184,22 @@ def measure_tpu(batch_txns: int, n_batches: int, key_space: int, seed: int,
             f"conflicts {results[name]['conflict_rate']:.3f}  "
             f"entries {int(cs.n)}")
 
-    # Sliding-window steady state (config 5): same as uniform but measured
-    # only after the resident window has filled, with GC active.
+    # Sliding-window steady state (config 5): continuous microbatches with
+    # the GC horizon chasing the version front. The REAL window is 5M
+    # versions (5 s at the reference version rate) — reaching true steady
+    # state there needs ~window/version_step = 300+ batches, far past a
+    # driver-run budget — so the bench scales the window to `fill` batches'
+    # worth of versions. The workload SHAPE (GC collapse + insert against a
+    # resident multi-100K-entry history every batch) is what config 5
+    # specifies; the window/version-rate ratio is the scaled parameter, and
+    # the resident entry count is reported so runs are comparable.
     name = "sliding_window"
     rng = np.random.default_rng(seed + 1)
     sampler = uniform_sampler(key_space)
     cs = ConflictSetTPU(max_key_bytes=8, initial_capacity=capacity)
     version = 10_000_000
-    fill = max(2, n_batches // 2)
+    fill = max(4, n_batches // 2)
+    sw_window = fill * version_step
     lat = []
     n_resolved = 0
     run_s = 0.0
@@ -200,17 +208,13 @@ def measure_tpu(batch_txns: int, n_batches: int, key_space: int, seed: int,
         txns = gen_batch(rng, batch_txns, v, sampler)
         pb = position_batch(pack_batch(txns, cs.oldest_version, cs.n_words))
         t0 = time.perf_counter()
-        st = cs.resolve_positioned(v, v - window, pb)
-        import numpy as _np
-
-        st = _np.asarray(st)
+        st = cs.resolve_positioned(v, v - sw_window, pb)
+        st = np.asarray(st)
         dt = time.perf_counter() - t0
         if b >= fill:
             lat.append(dt)
             run_s += dt
             n_resolved += pb.packed.n_txns
-    import numpy as np
-
     lat = np.array(lat)
     results[name] = {
         "batch_txns": batch_txns,
@@ -220,6 +224,7 @@ def measure_tpu(batch_txns: int, n_batches: int, key_space: int, seed: int,
         "p90_ms": float(np.percentile(lat, 90) * 1e3),
         "history_entries": int(cs.n),
         "capacity": cs.capacity,
+        "window_versions": sw_window,
     }
     log(f"[{name}] {results[name]['txns_per_sec']:.0f} txns/s  "
         f"p50 {results[name]['p50_ms']:.1f} ms  entries {int(cs.n)}")

@@ -214,23 +214,16 @@ def position_batch(packed: PackedBatch) -> PositionedBatch:
     )
 
 
-def pack_batch(
-    txns: Sequence[TxnConflictInfo],
-    oldest_version: int,
-    n_words: int,
-) -> PackedBatch:
-    """Flatten a transaction batch into padded tensors.
-
-    tooOld transactions (read_snapshot < oldestVersion with read ranges)
-    contribute no ranges, exactly like the reference's addTransaction
-    (fdbserver/SkipList.cpp:979-987). Txn indices are always batch-local;
-    chunked callers slice statuses by each chunk's n_txns.
-    """
-    n_txns = len(txns)
+def flatten_batch(txns: Sequence[TxnConflictInfo], oldest_version: int):
+    """Flatten txns into per-row lists, applying the admission rules shared
+    by every packer (tooOld txns contribute no ranges; empty ranges drop —
+    fdbserver/SkipList.cpp:979-987). Single source of truth: callers that
+    only need row COUNTS (e.g. the sharded path computing common shard
+    capacities) must use this same function so counts can never drift from
+    what pack_batch actually packs."""
     too_old_l = [
         t.read_snapshot < oldest_version and len(t.read_ranges) > 0 for t in txns
     ]
-
     r_begin: list[bytes] = []
     r_end: list[bytes] = []
     r_txn: list[int] = []
@@ -252,10 +245,35 @@ def pack_batch(
                 w_begin.append(w.begin)
                 w_end.append(w.end)
                 w_txn.append(i)
+    return too_old_l, r_begin, r_end, r_txn, r_snap, w_begin, w_end, w_txn
 
-    R = next_pow2(len(r_begin))
-    Wr = next_pow2(len(w_begin))
-    T = next_pow2(n_txns)
+
+def pack_batch(
+    txns: Sequence[TxnConflictInfo],
+    oldest_version: int,
+    n_words: int,
+    caps: tuple[int, int, int] | None = None,
+) -> PackedBatch:
+    """Flatten a transaction batch into padded tensors.
+
+    tooOld transactions (read_snapshot < oldestVersion with read ranges)
+    contribute no ranges, exactly like the reference's addTransaction
+    (fdbserver/SkipList.cpp:979-987). Txn indices are always batch-local;
+    chunked callers slice statuses by each chunk's n_txns.
+
+    `caps`, if given, is (read_cap, write_cap, txn_cap) minimum row
+    capacities — the multi-resolver path packs every shard to common shapes
+    so the stacked tensors shard evenly over the mesh.
+    """
+    n_txns = len(txns)
+    (too_old_l, r_begin, r_end, r_txn, r_snap, w_begin, w_end, w_txn) = (
+        flatten_batch(txns, oldest_version)
+    )
+
+    min_r, min_w, min_t = caps if caps is not None else (0, 0, 0)
+    R = next_pow2(max(len(r_begin), min_r))
+    Wr = next_pow2(max(len(w_begin), min_w))
+    T = next_pow2(max(n_txns, min_t))
 
     def padded_keys(keys: list[bytes], cap: int):
         words, lens = pack_keys(keys, n_words)

@@ -258,8 +258,7 @@ def _lower_rank(hw, hl, qw, ql):
     return pos
 
 
-@jax.jit
-def _resolve_kernel(
+def _resolve_kernel_impl(
     # state (sorted ascending; columns >= n are PAD); word-major keys
     hkw, hkl, hv, n,
     # sorted endpoints (P2-padded, word-major) + positions (host sort)
@@ -435,6 +434,11 @@ def _resolve_kernel(
         jnp.where(conflict > 0, jnp.int8(CONFLICT), jnp.int8(COMMITTED)),
     )
     return hkw_out, hkl_out, hv_out, new_n, statuses, overflow
+
+
+# Single-resolver entry point; the sharded multi-resolver path (sharded.py)
+# wraps _resolve_kernel_impl under shard_map instead.
+_resolve_kernel = jax.jit(_resolve_kernel_impl)
 
 
 class ConflictSetTPU:

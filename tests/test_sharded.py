@@ -1,0 +1,166 @@
+"""Multi-resolver sharding differential tests (BASELINE config 4).
+
+Runs the sharded TPU path on the 8-device virtual CPU mesh (conftest forces
+xla_force_host_platform_device_count=8) against the reference-semantics
+sharded CPU oracle: N independent conflict sets over a key-space partition,
+proxy-style max-combine of verdicts. Also pins the known semantic gap vs a
+single global set (a txn aborted on one shard still merges its writes on
+other shards — reference behavior, MasterProxyServer.actor.cpp:431-447).
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from foundationdb_tpu.kv.keys import KeyRange
+from foundationdb_tpu.resolver.sharded import (
+    ShardedConflictSetCPU,
+    clip_txns_to_shard,
+)
+from foundationdb_tpu.resolver.types import COMMITTED, CONFLICT, TxnConflictInfo
+
+
+def k8(x: int) -> bytes:
+    return struct.pack(">Q", int(x))
+
+
+def mesh_of(n):
+    import jax
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if len(devs) < n:
+        # The virtual multi-device mesh lives on the host platform
+        # (xla_force_host_platform_device_count=8, set in conftest).
+        devs = jax.devices("cpu")
+    if len(devs) < n:
+        pytest.skip(f"need {n} devices, have {len(devs)}")
+    return Mesh(np.array(devs[:n]), ("resolvers",))
+
+
+def make_sharded_tpu(boundaries, n_devices, **kw):
+    from foundationdb_tpu.resolver.sharded import ShardedConflictSetTPU
+
+    return ShardedConflictSetTPU(boundaries, mesh_of(n_devices), **kw)
+
+
+def random_txns(rng, n_txns, version, key_space=1000, lag=400):
+    txns = []
+    for _ in range(n_txns):
+        rr = []
+        for _ in range(rng.integers(0, 4)):
+            a = int(rng.integers(0, key_space))
+            b = a + int(rng.integers(1, 20))
+            rr.append(KeyRange(k8(a), k8(b)))
+        wr = []
+        for _ in range(rng.integers(0, 3)):
+            a = int(rng.integers(0, key_space))
+            wr.append(KeyRange(k8(a), k8(a + 1)))
+        snap = version - int(rng.integers(0, lag))
+        txns.append(TxnConflictInfo(snap, rr, wr))
+    return txns
+
+
+def test_clip_txns_to_shard():
+    t = TxnConflictInfo(5, [KeyRange(k8(10), k8(30))], [KeyRange(k8(25), k8(26))])
+    lo, hi = k8(20), k8(28)
+    [c] = clip_txns_to_shard([t], lo, hi)
+    assert c.read_ranges == [KeyRange(k8(20), k8(28))]
+    assert c.write_ranges == [KeyRange(k8(25), k8(26))]
+    # Non-overlapping shard: ranges drop entirely.
+    [c2] = clip_txns_to_shard([t], k8(100), None)
+    assert c2.read_ranges == [] and c2.write_ranges == []
+
+
+def test_sharded_oracle_matches_single_set_when_partition_invisible():
+    """With all keys inside one shard, the sharded oracle IS the single set."""
+    from foundationdb_tpu.resolver.cpu import ConflictSetCPU
+
+    rng = np.random.default_rng(0)
+    single = ConflictSetCPU()
+    sharded = ShardedConflictSetCPU([k8(10_000)])  # all traffic < 10_000
+    v = 1000
+    for _ in range(5):
+        txns = random_txns(rng, 30, v)
+        v += 100
+        assert (
+            single.resolve(v, 0, txns).statuses
+            == sharded.resolve(v, 0, txns).statuses
+        )
+
+
+def test_sharded_conservatism_is_reference_semantics():
+    """A txn aborted on shard A still merges its writes on shard B, so a
+    later reader of the shard-B key conflicts — matching the reference's
+    per-resolver independence, diverging from a single global set."""
+    b = k8(500)
+    sharded = ShardedConflictSetCPU([b])
+    from foundationdb_tpu.resolver.cpu import ConflictSetCPU
+
+    single = ConflictSetCPU()
+
+    # Txn W writes k1 (shard A) at v=10 so a later read of k1 conflicts.
+    setup = TxnConflictInfo(0, [], [KeyRange(k8(100), k8(101))])
+    for cs in (sharded, single):
+        assert cs.resolve(10, 0, [setup]).statuses == [COMMITTED]
+
+    # Txn X: reads k1 at snapshot 5 (conflict on shard A) and writes k2
+    # (shard B). Single set: aborted globally, k2 never merged.
+    x = TxnConflictInfo(
+        5, [KeyRange(k8(100), k8(101))], [KeyRange(k8(900), k8(901))]
+    )
+    assert sharded.resolve(20, 0, [x]).statuses == [CONFLICT]
+    assert single.resolve(20, 0, [x]).statuses == [CONFLICT]
+
+    # Txn Y: reads k2 at snapshot 15. Sharded (reference): shard B merged
+    # X's write at v=20 > 15 -> CONFLICT. Single set: COMMITTED.
+    y = TxnConflictInfo(15, [KeyRange(k8(900), k8(901))], [])
+    assert sharded.resolve(30, 0, [y]).statuses == [CONFLICT]
+    assert single.resolve(30, 0, [y]).statuses == [COMMITTED]
+
+
+@pytest.mark.parametrize("n_shards", [2, 4, 8])
+def test_sharded_tpu_differential(n_shards):
+    """Randomized batches: sharded TPU over the device mesh must produce
+    bit-identical statuses to the sharded CPU oracle."""
+    key_space = 1000
+    bounds = [k8(key_space * (i + 1) // n_shards) for i in range(n_shards - 1)]
+    oracle = ShardedConflictSetCPU(bounds)
+    tpu = make_sharded_tpu(
+        bounds, n_shards, max_key_bytes=8, initial_capacity=64
+    )
+    rng = np.random.default_rng(42 + n_shards)
+    v = 1000
+    for batch in range(8):
+        txns = random_txns(rng, 25, v, key_space=key_space)
+        v += 120
+        new_oldest = v - 600
+        a = oracle.resolve(v, new_oldest, txns).statuses
+        b = tpu.resolve(v, new_oldest, txns).statuses
+        assert a == b, f"batch {batch}: oracle {a} != tpu {b}"
+
+
+def test_sharded_tpu_growth():
+    """Per-shard history growth (overflow retry) preserves results."""
+    bounds = [k8(500)]
+    oracle = ShardedConflictSetCPU(bounds)
+    tpu = make_sharded_tpu(bounds, 2, max_key_bytes=8, initial_capacity=64)
+    rng = np.random.default_rng(9)
+    v = 100
+    for _ in range(4):
+        # 60 distinct writes/batch forces growth past 64 quickly.
+        txns = [
+            TxnConflictInfo(
+                v - 10,
+                [],
+                [KeyRange(k8(k), k8(k + 1)) for k in rng.integers(0, 1000, 2)],
+            )
+            for _ in range(30)
+        ]
+        v += 100
+        assert (
+            oracle.resolve(v, 0, txns).statuses
+            == tpu.resolve(v, 0, txns).statuses
+        )
+    assert tpu.capacity > 64
