@@ -1,26 +1,33 @@
-"""Host-side packing of conflict batches into fixed-shape integer tensors.
+"""Host-side packing of conflict batches into fused integer tensors.
 
 Keys are arbitrary byte strings; the TPU kernel needs a fixed-width,
-order-preserving projection (SURVEY.md §7 step 2). The projection used here
-is exact, not approximate, for every key up to ``8 * n_words`` bytes:
+order-preserving projection (SURVEY.md §7 step 2). The projection is exact
+for every key up to ``4 * n_words`` bytes:
 
     key  ->  (w_0, ..., w_{n-1}, len)
 
-where w_i is bytes [8i, 8i+8) of the key, zero-padded, read big-endian as a
-uint64, and len is the byte length. Lexicographic comparison of the tuple
-equals lexicographic byte comparison of the keys: if any word differs the
-big-endian order matches byte order; if all words agree the shorter key is a
-prefix of the longer one up to zero padding, and the length tiebreak matches
-byte order exactly (the reference's compare, fdbserver/SkipList.cpp:113-120).
+where w_i is bytes [4i, 4i+4) of the key, zero-padded, read big-endian as a
+uint32 and XOR-biased by 0x80000000 into int32 (so SIGNED int32 comparison
+equals unsigned byte order — TPU v5e has no native 64-bit or unsigned
+compare fast paths, int32 is the native lane type). Lexicographic comparison
+of the tuple equals lexicographic byte comparison of the keys: if any word
+differs the big-endian order matches byte order; if all words agree the
+shorter key is a prefix of the longer up to zero padding and the length
+tiebreak matches byte order exactly (the reference's compare,
+fdbserver/SkipList.cpp:113-120).
 
 Keys longer than the configured width raise KeyWidthError. As in the
-reference, oversized keys are a client-side admission error, not a resolver
-concern: FDB rejects keys above CLIENT_KNOBS->KEY_SIZE_LIMIT in
-Transaction::set/clear (fdbclient/NativeAPI.actor.cpp, key_too_large) before
-they can ever reach a resolver, so the conflict set may size its packed
-width from the deployment's key-size knob and treat KeyWidthError as an
-internal invariant violation. The client layer in this framework enforces
-the same limit at submission time.
+reference, oversized keys are a client-side admission error
+(CLIENT_KNOBS.KEY_SIZE_LIMIT, fdbclient/NativeAPI.actor.cpp key_too_large);
+the resolver sizes its packed width from the deployment's key-size knob.
+
+Why ONE fused buffer: the resolver sits on the commit critical path and the
+host→device link has high per-transfer fixed cost (measured ~1-4 ms per
+array dispatch on the dev tunnel, ~100 ms per synchronized round trip); a
+batch shipped as ~15 separate arrays pays that fixed cost 15 times. All
+per-batch tensors are therefore packed host-side into a single int32 vector
+with a static layout (FusedLayout) and unpacked on device with static
+slices, giving exactly one H2D transfer per resolve.
 
 Batch tensors are padded to power-of-two capacities so jit re-specializes on
 a small number of shape buckets (SURVEY.md §7 "batch-size bucketing").
@@ -36,10 +43,11 @@ import numpy as np
 from .types import TxnConflictInfo
 
 INT32_MAX = np.int32(2**31 - 1)
-PAD_WORD = np.uint64(0xFFFFFFFFFFFFFFFF)
-# Snapshot used for padding read rows: larger than any real version, so a
-# padded row can never report a conflict even unmasked.
-PAD_SNAPSHOT = np.int64(2**62)
+# Padding word: biased encoding of 0xFFFFFFFF == int32 max, so pad keys sort
+# above every real key (with the len tiebreak breaking the collision with a
+# real all-0xFF key, exactly like real keys).
+PAD_WORD = np.int32(2**31 - 1)
+BIAS = np.uint32(0x80000000)
 
 
 class KeyWidthError(ValueError):
@@ -54,12 +62,10 @@ def next_pow2(x: int, minimum: int = 8) -> int:
 
 
 def pack_keys(keys: Sequence[bytes], n_words: int) -> tuple[np.ndarray, np.ndarray]:
-    """Pack keys into (N, n_words) uint64 big-endian words + (N,) int32 lengths.
-
-    Fully vectorized: one concatenation + one masked scatter, no per-key
-    Python loop (a 64K-txn batch flattens to ~1M keys; see VERDICT r1 #4).
-    """
-    width = 8 * n_words
+    """Pack keys into (N, n_words) biased-int32 big-endian words + (N,)
+    int32 lengths. Fully vectorized: one concatenation + one masked scatter,
+    no per-key Python loop."""
+    width = 4 * n_words
     n = len(keys)
     lens = np.fromiter((len(k) for k in keys), dtype=np.int32, count=n)
     if n and int(lens.max()) > width:
@@ -68,150 +74,41 @@ def pack_keys(keys: Sequence[bytes], n_words: int) -> tuple[np.ndarray, np.ndarr
     buf = np.zeros((n, width), dtype=np.uint8)
     if n:
         flat = np.frombuffer(b"".join(keys), dtype=np.uint8)
-        # Row-major mask order matches concatenation order.
         mask = np.arange(width, dtype=np.int32)[None, :] < lens[:, None]
         buf[mask] = flat
     words = (
-        buf.reshape(n, n_words, 8).view(">u8")[..., 0].astype(np.uint64)
-    )
+        buf.reshape(n, n_words, 4).view(">u4")[..., 0].astype(np.uint32) ^ BIAS
+    ).view(np.int32)
     return words, lens
 
 
-@dataclass
-class PackedBatch:
-    """Fixed-shape tensors for one resolve() call. R/W rows beyond the valid
-    counts are padding (all-max keys, huge snapshots)."""
-
-    n_txns: int
-    # reads
-    rbw: np.ndarray  # (R, W) uint64
-    rbl: np.ndarray  # (R,) int32
-    rew: np.ndarray
-    rel: np.ndarray
-    rtxn: np.ndarray  # (R,) int32
-    rsnap: np.ndarray  # (R,) int64
-    # writes
-    wbw: np.ndarray
-    wbl: np.ndarray
-    wew: np.ndarray
-    wel: np.ndarray
-    wtxn: np.ndarray
-    w_valid: np.ndarray  # (Wr,) bool
-    # per-txn
-    too_old: np.ndarray  # (T,) bool
+def unpack_key(words: np.ndarray, length: int) -> bytes:
+    """Inverse of pack_keys for one key (tests/debugging)."""
+    u = (words.astype(np.int32).view(np.uint32) ^ BIAS).astype(">u4")
+    return u.tobytes()[:length]
 
 
-@dataclass
-class PositionedBatch:
-    """A PackedBatch plus the host-side endpoint sort.
-
-    The TPU backend deliberately never sorts on device: XLA's TPU sort is
-    fast to run but catastrophically slow to compile for multi-operand keys
-    (measured: 405 s for a 5-operand u64 sort vs ~1 s for the gathers and
-    scatters the kernel actually needs). Instead the host lexsorts the 2R+2Wr
-    batch endpoints — they are materialized host-side during packing anyway —
-    and the device merges them against the already-sorted resident history
-    with branchless binary searches (gathers only). This mirrors the
-    reference's split: ConflictBatch::addTransaction sorts the batch points
-    (SkipList.cpp:979, sortPoints :1163) before the skip-list walk.
-
-    Sorted-order arrays are padded to P2 = next_pow2(2R + 2Wr) with +inf
-    keys so the device-side binary searches stay branchless.
-
-    Endpoint tag order at equal keys is the reference tiebreak
-    read_end < write_end < write_begin < read_begin (SkipList.cpp:147-177),
-    which makes index-interval overlap equal half-open key-range overlap.
-    """
-
-    packed: PackedBatch
-    # sorted endpoints, padded to P2; WORD-MAJOR (W, P2) — TPU pads tiny
-    # minor dimensions to 128 lanes, so the large axis must be minor
-    sew: np.ndarray     # (W, P2) uint64 sorted endpoint words
-    sel: np.ndarray     # (P2,) int32 sorted lengths
-    stag: np.ndarray    # (P2,) int32 tags: 0=re, 1=we, 2=wb, 3=rb (pad: 0)
-    wsrc: np.ndarray    # (P2,) int32 write row for we/wb entries, else 0
-    same_ep: np.ndarray  # (P2,) bool: equals previous sorted endpoint
-    # positions of each original endpoint in the sorted order
-    q_end: np.ndarray   # (R,) int32
-    s_end: np.ndarray   # (Wr,) int32
-    s_begin: np.ndarray  # (Wr,) int32
-    q_begin: np.ndarray  # (R,) int32
-    # case-A compression (see tpu.py phase 2)
-    lo_r: np.ndarray    # (R,) int32  #write-begins strictly before q_begin
-    hi_r: np.ndarray    # (R,) int32  #write-begins strictly before q_end
-    perm_w: np.ndarray  # (Wr,) int32 write row of the i-th write-begin in order
+def state_pad_block(n_words: int, columns: int) -> np.ndarray:
+    """(n_words+2, columns) all-pad state columns: +inf keys, version 0.
+    Single source of truth for the device state layout shared by the
+    single-chip and sharded conflict sets (rows: key words, key length,
+    version offset)."""
+    block = np.zeros((n_words + 2, columns), dtype=np.int32)
+    block[:n_words, :] = PAD_WORD
+    block[n_words, :] = INT32_MAX
+    return block
 
 
-TAG_RE, TAG_WE, TAG_WB, TAG_RB = 0, 1, 2, 3
-
-
-def position_batch(packed: PackedBatch) -> PositionedBatch:
-    """Host-side endpoint sort + position/rank precomputation (all numpy)."""
-    R = packed.rbw.shape[0]
-    Wr = packed.wbw.shape[0]
-    W = packed.rbw.shape[1]
-    P = 2 * R + 2 * Wr
-    P2 = next_pow2(P)
-
-    # Concatenation order [r_end, w_end, w_begin, r_begin] = tag order.
-    words = np.concatenate([packed.rew, packed.wew, packed.wbw, packed.rbw])
-    lens = np.concatenate([packed.rel, packed.wel, packed.wbl, packed.rbl])
-    tags = np.concatenate(
-        [
-            np.full(R, TAG_RE, np.int32),
-            np.full(Wr, TAG_WE, np.int32),
-            np.full(Wr, TAG_WB, np.int32),
-            np.full(R, TAG_RB, np.int32),
-        ]
-    )
-    # Tag participates after length; payload (stable index) is implicit in
-    # np.lexsort's stability.
-    lt = (lens.astype(np.int64) << 3) | tags.astype(np.int64)
-    # np.lexsort sorts by the LAST key as primary -> keys are
-    # (len+tag, w_{W-1}, ..., w_0) so w_0 is primary, len+tag last.
-    order = np.lexsort((lt,) + tuple(words[:, j] for j in reversed(range(W))))
-    inv = np.empty(P, np.int32)
-    inv[order] = np.arange(P, dtype=np.int32)
-
-    q_end = inv[:R]
-    s_end = inv[R : R + Wr]
-    s_begin = inv[R + Wr : R + 2 * Wr]
-    q_begin = inv[R + 2 * Wr :]
-
-    sew = np.full((W, P2), PAD_WORD, dtype=np.uint64)
-    sel = np.full(P2, INT32_MAX, dtype=np.int32)
-    stag = np.zeros(P2, dtype=np.int32)
-    wsrc = np.zeros(P2, dtype=np.int32)
-    sew[:, :P] = words[order].T
-    sel[:P] = lens[order]
-    stag[:P] = tags[order]
-    src = np.zeros(P, dtype=np.int32)
-    src[R : R + Wr] = np.arange(Wr, dtype=np.int32)       # w_end rows
-    src[R + Wr : R + 2 * Wr] = np.arange(Wr, dtype=np.int32)  # w_begin rows
-    wsrc[:P] = src[order]
-
-    same_ep = np.zeros(P2, dtype=bool)
-    if P > 1:
-        eq = np.all(sew[:, 1:P] == sew[:, : P - 1], axis=0) & (
-            sel[1:P] == sel[: P - 1]
-        )
-        same_ep[1:P] = eq
-
-    is_wb = (stag[:P] == TAG_WB).astype(np.int64)
-    wb_excl = np.cumsum(is_wb) - is_wb  # #wb strictly before each position
-    lo_r = wb_excl[q_begin].astype(np.int32)
-    hi_r = wb_excl[q_end].astype(np.int32)
-    perm_w = wsrc[:P][stag[:P] == TAG_WB].astype(np.int32)
-    if perm_w.shape[0] != Wr:  # pragma: no cover - internal invariant
-        raise AssertionError("write-begin count mismatch")
-
-    return PositionedBatch(
-        packed=packed,
-        sew=sew, sel=sel, stag=stag, wsrc=wsrc, same_ep=same_ep,
-        q_end=q_end.astype(np.int32), s_end=s_end.astype(np.int32),
-        s_begin=s_begin.astype(np.int32), q_begin=q_begin.astype(np.int32),
-        lo_r=lo_r, hi_r=hi_r, perm_w=perm_w,
-    )
+def empty_state(n_words: int, capacity: int, init_version: int) -> np.ndarray:
+    """Fresh (n_words+2, capacity) state: all pad except the empty-key
+    sentinel at column 0 holding init_version (the reference's skip-list
+    header, fdbserver/SkipList.cpp:497 — baseline for all lookups)."""
+    hmat = state_pad_block(n_words, capacity)
+    w0, l0 = pack_keys([b""], n_words)
+    hmat[:n_words, 0] = w0[0]
+    hmat[n_words, 0] = l0[0]
+    hmat[n_words + 1, 0] = init_version
+    return hmat
 
 
 def flatten_batch(txns: Sequence[TxnConflictInfo], oldest_version: int):
@@ -248,18 +145,100 @@ def flatten_batch(txns: Sequence[TxnConflictInfo], oldest_version: int):
     return too_old_l, r_begin, r_end, r_txn, r_snap, w_begin, w_end, w_txn
 
 
+# Endpoint tag order at equal keys is the reference tiebreak
+# read_end < write_end < write_begin < read_begin (SkipList.cpp:147-177),
+# which makes index-interval overlap equal half-open key-range overlap.
+TAG_RE, TAG_WE, TAG_WB, TAG_RB = 0, 1, 2, 3
+
+
+@dataclass
+class FusedLayout:
+    """Static layout of the fused int32 batch buffer.
+
+    Segments, in order (all int32):
+      smat   (W+1)*P2  sorted endpoint key words + length row, word-major
+      q_begin  R       sorted position of each read's begin endpoint
+      q_end    R       sorted position of each read's end endpoint
+      s_begin  Wr      sorted position of each write's begin endpoint
+      s_end    Wr      sorted position of each write's end endpoint
+      is_wb    P2      1 where the sorted slot is a write-begin endpoint
+      is_we    P2      1 where the sorted slot is a write-end endpoint
+      rtxn     R       owning txn of each read row
+      rsnap    R       read snapshot as offset from the batch base version
+      wtxn     Wr      owning txn of each write row
+      w_valid  Wr      1 for real (non-pad) write rows
+      too_old  T       1 for tooOld txns
+      scalars  2       [version_off, oldest_off] (filled at resolve time)
+
+    The sort itself (np.lexsort) happens on host — XLA's TPU multi-operand
+    sort is catastrophically slow to compile (405 s measured for a 5-operand
+    sort) and the endpoints are materialized host-side anyway. Everything
+    derivable by cheap device ops (prefix sums over tags, same-as-previous
+    compares, canonical segment-tree nodes) is NOT shipped: it is cheaper to
+    recompute on device than to widen the single H2D transfer.
+    """
+
+    n_words: int
+    P2: int
+    R: int
+    Wr: int
+    T: int
+
+    def __post_init__(self):
+        W1 = self.n_words + 1
+        o = 0
+        self.off_smat = o; o += W1 * self.P2
+        self.off_q_begin = o; o += self.R
+        self.off_q_end = o; o += self.R
+        self.off_s_begin = o; o += self.Wr
+        self.off_s_end = o; o += self.Wr
+        self.off_is_wb = o; o += self.P2
+        self.off_is_we = o; o += self.P2
+        self.off_rtxn = o; o += self.R
+        self.off_rsnap = o; o += self.R
+        self.off_wtxn = o; o += self.Wr
+        self.off_w_valid = o; o += self.Wr
+        self.off_too_old = o; o += self.T
+        self.off_scalars = o; o += 2
+        self.total = o
+
+    def key(self):
+        return (self.n_words, self.P2, self.R, self.Wr, self.T)
+
+
+@dataclass
+class PackedBatch:
+    """One resolve()'s batch: the fused host buffer + its layout.
+
+    `base` is the absolute version all version fields are offsets from
+    (== the conflict set's oldest_version when packed; asserted at resolve).
+    Rows beyond the valid counts are padding (all-max keys, max snapshots).
+    """
+
+    n_txns: int
+    layout: FusedLayout
+    buf: np.ndarray  # (layout.total,) int32
+    base: int
+    n_reads: int
+    n_writes: int
+
+    def set_scalars(self, version_off: int, oldest_off: int) -> None:
+        self.buf[self.layout.off_scalars] = version_off
+        self.buf[self.layout.off_scalars + 1] = oldest_off
+
+
 def pack_batch(
     txns: Sequence[TxnConflictInfo],
     oldest_version: int,
     n_words: int,
     caps: tuple[int, int, int] | None = None,
 ) -> PackedBatch:
-    """Flatten a transaction batch into padded tensors.
+    """Flatten, sort and fuse a transaction batch into one int32 buffer.
 
-    tooOld transactions (read_snapshot < oldestVersion with read ranges)
-    contribute no ranges, exactly like the reference's addTransaction
-    (fdbserver/SkipList.cpp:979-987). Txn indices are always batch-local;
-    chunked callers slice statuses by each chunk's n_txns.
+    All heavy work is vectorized numpy; mirrors the reference's host-side
+    sortPoints (ConflictBatch::detectConflicts, fdbserver/SkipList.cpp:1163)
+    — the device then merges the sorted endpoints against the sorted
+    resident history by rank arithmetic instead of re-sorting.
 
     `caps`, if given, is (read_cap, write_cap, txn_cap) minimum row
     capacities — the multi-resolver path packs every shard to common shapes
@@ -274,10 +253,13 @@ def pack_batch(
     R = next_pow2(max(len(r_begin), min_r))
     Wr = next_pow2(max(len(w_begin), min_w))
     T = next_pow2(max(n_txns, min_t))
+    P = 2 * R + 2 * Wr
+    P2 = next_pow2(P)
+    nr, nw = len(r_begin), len(w_begin)
 
     def padded_keys(keys: list[bytes], cap: int):
         words, lens = pack_keys(keys, n_words)
-        pw = np.full((cap, n_words), PAD_WORD, dtype=np.uint64)
+        pw = np.full((cap, n_words), PAD_WORD, dtype=np.int32)
         pl = np.full(cap, INT32_MAX, dtype=np.int32)
         pw[: len(keys)] = words
         pl[: len(keys)] = lens
@@ -288,20 +270,60 @@ def pack_batch(
     wbw, wbl = padded_keys(w_begin, Wr)
     wew, wel = padded_keys(w_end, Wr)
 
-    rtxn = np.zeros(R, dtype=np.int32)
-    rtxn[: len(r_txn)] = r_txn
-    rsnap = np.full(R, PAD_SNAPSHOT, dtype=np.int64)
-    rsnap[: len(r_snap)] = r_snap
-    wtxn = np.zeros(Wr, dtype=np.int32)
-    wtxn[: len(w_txn)] = w_txn
-    w_valid = np.zeros(Wr, dtype=bool)
-    w_valid[: len(w_txn)] = True
-    too_old = np.zeros(T, dtype=bool)
-    too_old[:n_txns] = too_old_l
+    # Concatenation order [r_end, w_end, w_begin, r_begin] = tag order.
+    words = np.concatenate([rew, wew, wbw, rbw])
+    lens = np.concatenate([rel, wel, wbl, rbl])
+    tags = np.concatenate(
+        [
+            np.full(R, TAG_RE, np.int32),
+            np.full(Wr, TAG_WE, np.int32),
+            np.full(Wr, TAG_WB, np.int32),
+            np.full(R, TAG_RB, np.int32),
+        ]
+    )
+    # Sort by (words..., len, tag); np.lexsort's primary key is the LAST.
+    lt = (lens.astype(np.int64) << 3) | tags.astype(np.int64)
+    order = np.lexsort(
+        (lt,) + tuple(words[:, j] for j in reversed(range(n_words)))
+    )
+    inv = np.empty(P, np.int32)
+    inv[order] = np.arange(P, dtype=np.int32)
+
+    lay = FusedLayout(n_words, P2, R, Wr, T)
+    buf = np.zeros(lay.total, dtype=np.int32)
+    W1 = n_words + 1
+    smat = buf[lay.off_smat : lay.off_smat + W1 * P2].reshape(W1, P2)
+    smat[:n_words, :] = PAD_WORD
+    smat[n_words, :] = INT32_MAX
+    smat[:n_words, :P] = words[order].T
+    smat[n_words, :P] = lens[order]
+    sorted_tags = tags[order]
+    buf[lay.off_is_wb : lay.off_is_wb + P] = sorted_tags == TAG_WB
+    buf[lay.off_is_we : lay.off_is_we + P] = sorted_tags == TAG_WE
+
+    buf[lay.off_q_end : lay.off_q_end + R] = inv[:R]
+    buf[lay.off_s_end : lay.off_s_end + Wr] = inv[R : R + Wr]
+    buf[lay.off_s_begin : lay.off_s_begin + Wr] = inv[R + Wr : R + 2 * Wr]
+    buf[lay.off_q_begin : lay.off_q_begin + R] = inv[R + 2 * Wr :]
+
+    rtxn = buf[lay.off_rtxn : lay.off_rtxn + R]
+    rtxn[:nr] = r_txn
+    rsnap = buf[lay.off_rsnap : lay.off_rsnap + R]
+    rsnap[:] = INT32_MAX
+    if nr:
+        rel_snap = np.asarray(r_snap, dtype=np.int64) - oldest_version
+        if rel_snap.min() < 0 or rel_snap.max() >= 2**31:
+            raise ValueError(
+                "read snapshot outside the int32 window relative to "
+                f"oldest_version={oldest_version}"
+            )
+        rsnap[:nr] = rel_snap.astype(np.int32)
+    wtxn = buf[lay.off_wtxn : lay.off_wtxn + Wr]
+    wtxn[:nw] = w_txn
+    buf[lay.off_w_valid : lay.off_w_valid + nw] = 1
+    buf[lay.off_too_old : lay.off_too_old + n_txns] = too_old_l
 
     return PackedBatch(
-        n_txns=n_txns,
-        rbw=rbw, rbl=rbl, rew=rew, rel=rel, rtxn=rtxn, rsnap=rsnap,
-        wbw=wbw, wbl=wbl, wew=wew, wel=wel, wtxn=wtxn, w_valid=w_valid,
-        too_old=too_old,
+        n_txns=n_txns, layout=lay, buf=buf, base=oldest_version,
+        n_reads=nr, n_writes=nw,
     )

@@ -3,73 +3,72 @@
 Replaces the reference's per-range skip-list walk (SkipList::detectConflicts,
 fdbserver/SkipList.cpp:524-553, driven by ConflictBatch::detectConflicts
 :1163-1208) with fixed-shape tensor passes sized for 64K-1M transaction
-batches, designed TPU-first around what actually compiles and runs fast on
-the hardware (all numbers measured on a v5 lite chip):
+batches, designed TPU-first around measured v5e behavior:
 
-- 1-D gathers, scatters and branchless binary searches compile in ~1 s and
-  run in ~0.05 ms at 1M elements — the kernel is built almost entirely from
-  them. Key tensors are WORD-MAJOR (W, N): a (N, 4) layout puts 4 in the
-  lane dimension and TPU pads it to 128 lanes (32x memory and gather
-  waste — measured 242 ms vs ~7 ms for the same searches), so every array
-  keeps its large axis minor.
-- XLA's TPU variadic sort runs fast but takes minutes to COMPILE for
-  multi-word keys (405 s measured), and lax.cumsum takes ~17 s — so the
-  kernel contains no device sort (the host lexsorts batch endpoints during
-  packing, mirroring the reference's sortPoints; the device merges them
-  against the resident sorted history by binary search) and no lax.cumsum
-  (prefix sums are unrolled log-step Hillis-Steele adds).
-- One binary search total: lb = #history < key. ub = #history <= key
-  follows from lb plus one equality probe (history keys are unique), and
-  the endpoint-rank-of-history lbB = #endpoints < hist follows from ub by
-  the merge duality  #B < A[j] = #{p : ub[p] <= j}  — a scatter-count and
-  a prefix sum instead of two more searches.
+- The cost model on this hardware is OP COUNT times a per-op floor
+  (~1-4 ms per 0.5-1M-element gather/scatter dispatch), not FLOPs. The
+  kernel therefore minimizes the NUMBER of gather/scatter ops: every probe
+  step gathers all key words + length in ONE 2D row-gather from a single
+  (W+2, C) state matrix (measured 3x cheaper than per-row gathers);
+  range-max queries use a sparse table (2 gathers total) instead of a
+  segment-tree walk (2 log C gathers); multiple boolean planes are packed
+  into bit fields of one int32 and scattered once.
+- Everything is int32: v5e has no native int64, and emulated-wide compares
+  and scatters tax every pass. Versions are stored as int32 offsets from a
+  host-tracked absolute base (the conflict set's oldest_version) and are
+  rebased on every GC advance — a 5s window at the reference's 1M
+  versions/s (fdbserver/Knobs.cpp:59-61) needs 23 bits. Keys are biased
+  int32 words (packing.py).
+- jnp.cumsum / lax.cummax are the scan primitives (measured 6x faster than
+  hand-rolled log-step shifted adds at 1M elements; their XLA compile cost
+  is amortized across instances of the same shape).
+- No device sort and no device transfer fan-out: the host lexsorts batch
+  endpoints during packing (mirroring the reference's sortPoints) and ships
+  the whole batch as ONE fused int32 buffer (packing.py FusedLayout); the
+  device merges endpoints against the sorted resident history by rank
+  arithmetic.
 
 Phases (semantics identical to the CPU oracle in cpu.py):
 
 1. Read-vs-history (CheckMax, SkipList.cpp:755-837): history is a step
-   function version(x) held on device as sorted packed-key tensors; the max
-   version over each read range comes from an O(C) subtree-max segment tree
-   built with static slices and queried with an unrolled canonical-node
-   walk.
+   function version(x) held on device as the sorted (W+2, C) matrix; the
+   max version over each read range comes from a sparse range-max table.
 2. Intra-batch (checkIntraBatchConflicts, SkipList.cpp:1133-1158): the
    sequential "reads of txn t vs writes of earlier still-committed txns"
    rule is the unique fixed point of
        A(t) = hist(t) | tooOld(t) | exists j < t: !A(j) and writes_j
               overlap reads_t
-   (unique because A(t) depends only on A(j), j < t), reached by iteration
-   under lax.while_loop. Each iteration asks, per read r, for the minimum
-   writer index among committed writes overlapping r in endpoint-position
-   space (positions from the host sort), split into:
-     case A — the write BEGINS strictly inside the read's span: range-min
-       over a sparse table of writer indices in write-begin position order
-       (rank compression precomputed on host);
-     case B — the write COVERS the read's begin position: one flat
-       scatter-min of writer indices onto precomputed canonical
-       segment-tree nodes of each write span, then a stabbing query = min
-       over the read-begin leaf's ancestors (log P 1-D gathers).
-   The loop body is ~1 scatter + gathers; everything shape-dependent is
-   hoisted out of the loop.
+   reached by iteration under lax.while_loop. Per iteration, the minimum
+   committed writer overlapping each read splits into: case A — the write
+   BEGINS strictly inside the read's span (sparse range-min over writer
+   indices in write-begin order); case B — the write COVERS the read's
+   begin position (one scatter-min onto canonical segment-tree nodes of
+   each write span + one flattened ancestor gather per read).
 3. Write merge + GC (addConflictRanges :511-523, removeBefore :665-702):
-   merge-by-rank: endpoint merged position = index + ub, history merged
-   position = index + lbB — unique positions, two unique-destination
-   scatters build the merged sequence. Committed write coverage (prefix
-   sums of begin/end flags) overrides the step function at the batch
-   version, horizon-stale versions clamp to 0 (observationally identical,
-   see cpu.py), equal neighbours coalesce, and two scatter compactions
-   (unique destinations; dump-slot writes use .max so the result is
-   scatter-order independent, hence deterministic) produce the new sorted
-   state. Overflow of the fixed capacity is reported to the host, which
-   grows the state and re-runs the identical batch.
+   merge-by-rank — endpoint merged position = index + ub, history merged
+   position = index + lbB (from the duality #B<A[j] = #{p: ub[p] <= j},
+   one scatter-count + prefix sum) — then run detection, committed-write
+   coverage, stale clamp to 0, coalescing of equal neighbours, and two
+   scatter compactions (unique destinations; dump-slot writes use .max so
+   the result is scatter-order independent, hence deterministic). Output
+   versions are rebased to the new oldest_version. Overflow of the fixed
+   capacity cannot occur: the host pre-grows from a pessimistic bound
+   (n + 2*writes) before dispatch; the kernel still reports it for an
+   invariant check.
 
-Batches of unbounded size are CHUNKED (resolve() → resolve_packed() per
+Batches of unbounded size are CHUNKED (resolve() -> one kernel call per
 chunk): all transactions of one resolve share a commit version, and since
 every snapshot precedes that version, a read conflicting with an earlier
 chunk's committed write via merged history is exactly the intra-batch rule —
 so chunked resolution yields observationally identical statuses and final
-state to one giant batch (intermediate chunks clamp GC against the pre-batch
-horizon, so interior entry counts and growth timing can differ) while
-bounding HBM and the set of compiled shapes (SURVEY.md §7 "batch-size
-bucketing").
+state to one giant batch while bounding HBM and the set of compiled shapes
+(SURVEY.md §7 "batch-size bucketing").
+
+The host API is asynchronous (resolve_async -> PendingResolve): dispatch
+enqueues one H2D transfer + one kernel and returns immediately, so the
+transfer and host packing of batch N+1 overlap the kernel of batch N —
+the double-buffered H2D pipeline SURVEY §7 calls for. No host-device sync
+happens anywhere on the dispatch path.
 
 Everything is integer arithmetic: no floats, so determinism does not depend
 on reduction order — a requirement for replayable simulation (SURVEY.md §7).
@@ -81,102 +80,104 @@ from typing import Sequence
 
 import numpy as np
 
+import jax
+import jax.numpy as jnp
+from jax import lax
+
 from .cpu import ConflictSetCPU  # noqa: F401  (CPU twin, same contract)
 from .packing import (
     INT32_MAX,
     PAD_WORD,
-    TAG_RB,
-    TAG_RE,
-    TAG_WB,
-    TAG_WE,
     KeyWidthError,  # noqa: F401  (re-export: admission errors, see packing.py)
+    FusedLayout,
     PackedBatch,
-    PositionedBatch,
     next_pow2,
     pack_batch,
-    position_batch,
+    unpack_key,
 )
 from .types import COMMITTED, CONFLICT, TOO_OLD, ConflictBatchResult, TxnConflictInfo
 
-_I32_INF = np.int32(2**31 - 1)
-
-_x64_ready = False
+_I32_INF = jnp.int32(2**31 - 1)
 
 
-def ensure_x64() -> None:
-    """Enable 64-bit JAX types, required for version arithmetic (FDB versions
-    advance at 1M/s — fdbserver/Knobs.cpp:59 — so int32 wraps in minutes).
-
-    Called from ConflictSetTPU construction rather than at import so that
-    importing this module never mutates process-global JAX config behind an
-    unrelated user's back (ADVICE r1). The framework's own server processes
-    own their JAX runtime, so flipping the flag here is legitimate there.
-    """
-    global _x64_ready
-    if _x64_ready:
-        return
-    import jax
-
-    if not jax.config.jax_enable_x64:
-        jax.config.update("jax_enable_x64", True)
-    _x64_ready = True
+def _lex_lt_eq(h, q, or_equal: bool = False):
+    """Lexicographic h < q (or <=) over leading-axis word rows."""
+    lt = jnp.zeros(h.shape[1:], dtype=bool)
+    eq = jnp.ones(h.shape[1:], dtype=bool)
+    for j in range(h.shape[0]):
+        lt = lt | (eq & (h[j] < q[j]))
+        eq = eq & (h[j] == q[j])
+    if or_equal:
+        lt = lt | eq
+    return lt, eq
 
 
-import jax  # noqa: E402
-import jax.numpy as jnp  # noqa: E402
-from jax import lax  # noqa: E402
+def _lower_rank(hkeys, qmat):
+    """#entries of the sorted (C, +inf padded) key matrix strictly less than
+    each query key. log C unrolled probe steps; ONE 2D row-gather per step."""
+    c = hkeys.shape[1]
+    pos = jnp.zeros(qmat.shape[1], dtype=jnp.int32)
+    s = c // 2
+    while s >= 1:
+        h = hkeys[:, pos + (s - 1)]
+        lt, _ = _lex_lt_eq(h, qmat)
+        pos = pos + jnp.where(lt, s, 0)
+        s //= 2
+    return pos
 
 
-def _cumsum_i32(x: jnp.ndarray) -> jnp.ndarray:
-    """Inclusive prefix sum via unrolled Hillis-Steele shifted adds.
-
-    lax.cumsum takes ~17 s of XLA compile time at 1M elements on TPU; this
-    is log2(n) pad+add steps that compile in well under a second and stay
-    bandwidth-bound at run time."""
-    n = x.shape[0]
+def _build_max_table(v):
+    """(L, C) sparse table over versions: row m holds max over [i, i+2^m)."""
+    c = v.shape[0]
+    rows = [v]
     s = 1
-    while s < n:
-        x = x + jnp.pad(x[:-s], (s, 0))
+    while s < c:
+        prev = rows[-1]
+        shifted = jnp.concatenate([prev[s:], jnp.zeros(s, dtype=v.dtype)])
+        rows.append(jnp.maximum(prev, shifted))
         s *= 2
-    return x
+    return jnp.stack(rows)
 
 
-def _build_max_tree(leaves: jnp.ndarray) -> jnp.ndarray:
-    """Subtree-max segment tree over C (power-of-two) leaves, built with
-    static slices only (log C dynamic-update-slice ops — cheap to compile)."""
-    c = leaves.shape[0]
-    s = jnp.concatenate([jnp.zeros(c, dtype=leaves.dtype), leaves])
-    lo = c // 2
-    while lo >= 1:
-        children = s[2 * lo : 4 * lo]
-        pairmax = jnp.maximum(children[0::2], children[1::2])
-        s = s.at[lo : 2 * lo].set(pairmax)
-        lo //= 2
-    return s
+def _table_range_max(table, lo, hi):
+    """Max over [lo, hi) per query via the sparse table; empty ranges -> 0.
+    One flattened 2-row gather."""
+    c = table.shape[1]
+    length = (hi - lo).astype(jnp.int32)
+    m = 31 - lax.clz(jnp.maximum(length, 1))
+    window = jnp.left_shift(jnp.int32(1), m)
+    flat = table.reshape(-1)
+    i1 = m * c + jnp.clip(lo, 0, c - 1)
+    i2 = m * c + jnp.clip(hi - window, 0, c - 1)
+    got = flat[jnp.stack([i1, i2])]
+    return jnp.where(hi > lo, jnp.maximum(got[0], got[1]), 0)
 
 
-def _tree_range_max(s: jnp.ndarray, lo: jnp.ndarray, hi: jnp.ndarray):
-    """Vectorized range-max over [lo, hi) against a subtree-max tree.
-    Standard iterative canonical-node walk, unrolled log C times; every step
-    is mask arithmetic + one 1-D gather. Empty ranges return 0."""
-    c = s.shape[0] // 2
-    res = jnp.zeros(lo.shape, dtype=s.dtype)
-    l = (lo + c).astype(jnp.int32)
-    r = (hi + c).astype(jnp.int32)
-    for _ in range(c.bit_length()):
-        active = l < r
-        tl = active & ((l & 1) == 1)
-        res = jnp.where(tl, jnp.maximum(res, s[jnp.where(tl, l, 0)]), res)
-        l = l + tl
-        tr = active & ((r & 1) == 1)
-        r = r - tr
-        res = jnp.where(tr, jnp.maximum(res, s[jnp.where(tr, r, 0)]), res)
-        l = l >> 1
-        r = r >> 1
-    return res
+def _build_min_table(v):
+    c = v.shape[0]
+    rows = [v]
+    s = 1
+    while s < c:
+        prev = rows[-1]
+        shifted = jnp.concatenate([prev[s:], jnp.full(s, _I32_INF)])
+        rows.append(jnp.minimum(prev, shifted))
+        s *= 2
+    return jnp.stack(rows)
 
 
-def _canonical_nodes_flat(pos_lo: jnp.ndarray, pos_hi: jnp.ndarray, n_leaves: int):
+def _table_range_min(table, lo, hi):
+    c = table.shape[1]
+    length = (hi - lo).astype(jnp.int32)
+    m = 31 - lax.clz(jnp.maximum(length, 1))
+    window = jnp.left_shift(jnp.int32(1), m)
+    flat = table.reshape(-1)
+    i1 = m * c + jnp.clip(lo, 0, c - 1)
+    i2 = m * c + jnp.clip(hi - window, 0, c - 1)
+    got = flat[jnp.stack([i1, i2])]
+    return jnp.where(hi > lo, jnp.minimum(got[0], got[1]), _I32_INF)
+
+
+def _canonical_nodes_flat(pos_lo, pos_hi, n_leaves: int):
     """Canonical segment-tree node ids of each [pos_lo, pos_hi) interval,
     flattened to 1-D (2*steps blocks of N), 0 marking unused slots (node 0
     is never a real node — root is 1). Pure integer arithmetic."""
@@ -197,122 +198,79 @@ def _canonical_nodes_flat(pos_lo: jnp.ndarray, pos_hi: jnp.ndarray, n_leaves: in
     return jnp.concatenate(cols), 2 * steps
 
 
-def _min_table(values: jnp.ndarray) -> jnp.ndarray:
-    """(K, N) sparse table: row m holds min over windows [i, i + 2^m)."""
-    c = values.shape[0]
-    rows = [values]
-    step = 1
-    idx_base = jnp.arange(c, dtype=jnp.int32)
-    while step < c:
-        prev = rows[-1]
-        idx = jnp.minimum(idx_base + step, c - 1)
-        rows.append(jnp.minimum(prev, prev[idx]))
-        step *= 2
-    return jnp.stack(rows)
-
-
-def _table_range_min(table: jnp.ndarray, lo: jnp.ndarray, hi: jnp.ndarray):
-    """Min over [lo, hi) per query; empty ranges return INT32_MAX."""
-    c = table.shape[1]
-    length = (hi - lo).astype(jnp.int32)
-    m = 31 - lax.clz(jnp.maximum(length, 1))
-    window = jnp.left_shift(jnp.int32(1), m)
-    left = table[m, jnp.clip(lo, 0, c - 1)]
-    right = table[m, jnp.clip(hi - window, 0, c - 1)]
-    return jnp.where(hi > lo, jnp.minimum(left, right), _I32_INF)
-
-
-def _probe_lt(hw, hl, idx, qw, ql, or_equal: bool):
-    """hist[idx] < query (or <=): lexicographic over W big-endian u64 word
-    rows (word-major (W, C)) then byte length. W+1 1-D gathers."""
-    res = jnp.zeros(idx.shape, dtype=bool)
-    eq = jnp.ones(idx.shape, dtype=bool)
-    for j in range(hw.shape[0]):
-        h = hw[j][idx]
-        res = res | (eq & (h < qw[j]))
-        eq = eq & (h == qw[j])
-    hlen = hl[idx]
-    res = res | (eq & (hlen < ql))
-    if or_equal:
-        res = res | (eq & (hlen == ql))
-    return res
-
-
-def _probe_eq(hw, hl, idx, qw, ql):
-    eq = hl[idx] == ql
-    for j in range(hw.shape[0]):
-        eq = eq & (hw[j][idx] == qw[j])
-    return eq
-
-
-def _lower_rank(hw, hl, qw, ql):
-    """#entries of the sorted (power-of-two, +inf padded, word-major) array
-    strictly less than each query key. log C unrolled probe steps."""
-    c = hw.shape[1]
-    pos = jnp.zeros(ql.shape, dtype=jnp.int32)
-    s = c // 2
-    while s >= 1:
-        take = _probe_lt(hw, hl, pos + (s - 1), qw, ql, or_equal=False)
-        pos = pos + jnp.where(take, s, 0)
-        s //= 2
-    return pos
-
-
-def _resolve_kernel_impl(
-    # state (sorted ascending; columns >= n are PAD); word-major keys
-    hkw, hkl, hv, n,
-    # sorted endpoints (P2-padded, word-major) + positions (host sort)
-    sew, sel, stag, wsrc, same_ep,
-    q_end, s_end, s_begin, q_begin,
-    lo_r, hi_r, perm_w,
-    # per-row batch data (original order)
-    rtxn, rsnap, wtxn, w_valid, too_old,
-    # scalars
-    version, oldest_eff,
-):
-    W, C = hkw.shape
-    P2 = sew.shape[1]
-    T = too_old.shape[0]
+def _resolve_kernel_impl(hmat, n, fused, *, lay: FusedLayout):
+    """One resolve step. hmat: (W+2, C) int32 state [words.., len, version];
+    n: live entry count; fused: the batch buffer (packing.FusedLayout).
+    Returns (hmat_out, new_n, statuses, overflow)."""
+    W = lay.n_words
+    C = hmat.shape[1]
+    P2, R, Wr, T = lay.P2, lay.R, lay.Wr, lay.T
     i32 = jnp.int32
-    sew_rows = [sew[j] for j in range(W)]
+
+    # ---- unpack the fused buffer (static slices; one H2D behind us) ----
+    smat = lax.dynamic_slice_in_dim(fused, lay.off_smat, (W + 1) * P2).reshape(
+        W + 1, P2
+    )
+    sl = lambda off, size: lax.dynamic_slice_in_dim(fused, off, size)
+    q_begin = sl(lay.off_q_begin, R)
+    q_end = sl(lay.off_q_end, R)
+    s_begin = sl(lay.off_s_begin, Wr)
+    s_end = sl(lay.off_s_end, Wr)
+    is_wb = sl(lay.off_is_wb, P2)
+    is_we = sl(lay.off_is_we, P2)
+    rtxn = sl(lay.off_rtxn, R)
+    rsnap = sl(lay.off_rsnap, R)
+    wtxn = sl(lay.off_wtxn, Wr)
+    w_valid = sl(lay.off_w_valid, Wr).astype(bool)
+    too_old = sl(lay.off_too_old, T).astype(bool)
+    version = fused[lay.off_scalars]
+    oldest_eff = fused[lay.off_scalars + 1]
+
+    hkeys = hmat[: W + 1]
+    hv = hmat[W + 1]
 
     # ============ Ranks: one binary search + algebraic derivations ============
-    lb = _lower_rank(hkw, hkl, sew_rows, sel)                  # #h < key
-    eq = _probe_eq(hkw, hkl, jnp.clip(lb, 0, C - 1), sew_rows, sel)
-    is_pad_q = sel == INT32_MAX
-    ub = jnp.where(is_pad_q, C, lb + eq)                        # #h <= key
-    # (pad queries count all pad history rows so merged positions of pads
-    # stay collision-free; see phase 3.)
+    lb = _lower_rank(hkeys, smat)                        # #h < key
+    _, eq = _lex_lt_eq(hkeys[:, jnp.clip(lb, 0, C - 1)], smat)
+    is_pad_q = smat[W] == INT32_MAX
+    ub = jnp.where(is_pad_q, C, lb + eq)                  # #h <= key
+    # (pad queries count all history rows so merged positions of pads stay
+    # collision-free in phase 3.)
 
     # ============ Phase 1: read-vs-history ============
     rank_e = lb[q_end]    # #h < read_end
     rank_b = ub[q_begin]  # #h <= read_begin  (>= 1: sentinel "" is minimal)
-    tree = _build_max_tree(hv)
-    hist_max = _tree_range_max(tree, rank_b - 1, rank_e)
+    vtab = _build_max_table(hv)
+    hist_max = _table_range_max(vtab, rank_b - 1, rank_e)
     read_conf = (hist_max > rsnap).astype(i32)
     hist_conf = jnp.zeros(T, dtype=i32).at[rtxn].max(read_conf)
     base_conf = jnp.maximum(hist_conf, too_old.astype(i32))
 
     # ============ Phase 2: intra-batch fixed point ============
-    n_leaves = P2
-    k_levels = n_leaves.bit_length()
-    wnodes, n_blocks = _canonical_nodes_flat(s_begin, s_end, n_leaves)
-    Wr = wtxn.shape[0]
+    # Derived-on-device position metadata (cheaper than widening the H2D).
+    wb_excl = jnp.cumsum(is_wb) - is_wb   # #write-begins strictly before pos
+    lh = wb_excl[jnp.stack([q_begin, q_end])]
+    lo_r, hi_r = lh[0], lh[1]
+    rank_w = wb_excl[s_begin]             # rank of each write among wb's
+    perm_w = jnp.zeros(Wr, dtype=i32).at[rank_w].set(
+        jnp.arange(Wr, dtype=i32)
+    )
+    wnodes, n_blocks = _canonical_nodes_flat(s_begin, s_end, P2)
+    k_levels = P2.bit_length()
+    # Ancestors of each read-begin leaf, flattened for a single 2D gather
+    # per loop iteration.
+    anc = (q_begin[None, :] + P2) >> jnp.arange(k_levels, dtype=i32)[:, None]
 
     def body(carry):
         conflict, _, it = carry
         committed_w = w_valid & (conflict[wtxn] == 0)
         wval = jnp.where(committed_w, wtxn, _I32_INF).astype(i32)
         # Case A: writes beginning strictly inside the read's span.
-        case_a = _table_range_min(_min_table(wval[perm_w]), lo_r, hi_r)
+        case_a = _table_range_min(_build_min_table(wval[perm_w]), lo_r, hi_r)
         # Case B: writes covering the read's begin position.
         wval_rep = jnp.broadcast_to(wval, (n_blocks, Wr)).reshape(-1)
-        tree_l = jnp.full(2 * n_leaves, _I32_INF, dtype=i32)
-        tree_l = tree_l.at[wnodes].min(wval_rep)
-        leaf = q_begin + n_leaves
-        stab = jnp.full(leaf.shape, _I32_INF, dtype=i32)
-        for k in range(k_levels):
-            stab = jnp.minimum(stab, tree_l[leaf >> k])
+        tree_l = jnp.full(2 * P2, _I32_INF, dtype=i32).at[wnodes].min(wval_rep)
+        stab = jnp.min(tree_l[anc], axis=0)
         min_writer = jnp.minimum(case_a, stab)
         evidence = (min_writer < rtxn).astype(i32)
         ev_txn = jnp.zeros(T, dtype=i32).at[rtxn].max(evidence)
@@ -333,98 +291,113 @@ def _resolve_kernel_impl(
     N3 = C + P2
 
     # Merge duality: #endpoints < hist[j] = #{p : ub[p] <= j}. One
-    # scatter-count over ub plus a prefix sum replaces a third search.
+    # scatter-count over ub plus a prefix sum replaces a second search.
     cnt_ub = jnp.zeros(C + 1, dtype=i32).at[jnp.minimum(ub, C)].add(1)
-    lbB = _cumsum_i32(cnt_ub[:C])
+    lbB = jnp.cumsum(cnt_ub[:C])
     posA = jnp.arange(C, dtype=i32) + lbB          # history -> merged
     posB = jnp.arange(P2, dtype=i32) + ub          # endpoints -> merged
     # Ties are history-first, so merged positions are a permutation of N3.
 
-    is_h_m = jnp.zeros(N3, dtype=i32).at[posA].set((jnp.arange(C) < n).astype(i32))
-    committed_ep = committed_w[wsrc]
-    is_wb_m = jnp.zeros(N3, dtype=i32).at[posB].set(
-        ((stag == TAG_WB) & committed_ep).astype(i32)
-    )
-    is_we_m = jnp.zeros(N3, dtype=i32).at[posB].set(
-        ((stag == TAG_WE) & committed_ep).astype(i32)
-    )
+    # Committed flags per sorted endpoint slot (write rows -> their slots).
+    cwb = jnp.zeros(P2, dtype=i32).at[s_begin].set(committed_w.astype(i32))
+    cwe = jnp.zeros(P2, dtype=i32).at[s_end].set(committed_w.astype(i32))
 
     # same-as-previous in merged space. History entries are unique and equal
     # endpoints sort after their equal history entry, so a history element is
     # never equal to its merged predecessor; an endpoint's predecessor is the
     # previous endpoint iff their merged positions are adjacent, else it is
     # history entry ub-1 (equal to the key iff eq).
+    same_ep = jnp.concatenate(
+        [
+            jnp.zeros(1, dtype=bool),
+            jnp.all(smat[:, 1:] == smat[:, :-1], axis=0),
+        ]
+    )
     prev_is_ep = jnp.concatenate(
         [jnp.zeros(1, dtype=bool), posB[1:] == posB[:-1] + 1]
     )
     same_prev_ep = jnp.where(prev_is_ep, same_ep, eq & (ub > 0))
-    same_prev_m = jnp.zeros(N3, dtype=bool).at[posB].set(same_prev_ep)
 
-    cum_h = _cumsum_i32(is_h_m)
-    cum_wb = _cumsum_i32(is_wb_m)
-    cum_we = _cumsum_i32(is_we_m)
+    # Bit-packed merged planes, built with ONE scatter over all N3 slots:
+    # bit0 is_hist, bit1 cwb, bit2 cwe, bit3 same_prev, bits4+ source column
+    # in the concatenated [history | sorted endpoints] key matrix.
+    val_a = (jnp.arange(C, dtype=i32) < n).astype(i32) + (
+        jnp.arange(C, dtype=i32) << 4
+    )
+    val_b = (
+        (cwb << 1)
+        + (cwe << 2)
+        + (same_prev_ep.astype(i32) << 3)
+        + ((C + jnp.arange(P2, dtype=i32)) << 4)
+    )
+    merged = (
+        jnp.zeros(N3, dtype=i32)
+        .at[jnp.concatenate([posA, posB])]
+        .set(jnp.concatenate([val_a, val_b]))
+    )
+    is_h_m = merged & 1
+    cwb_m = (merged >> 1) & 1
+    cwe_m = (merged >> 2) & 1
+    same_prev_m = ((merged >> 3) & 1).astype(bool)
+    src_m = merged >> 4
 
-    run_id = _cumsum_i32((~same_prev_m).astype(i32)) - 1
+    cum_h = jnp.cumsum(is_h_m)
+    cum_wb = jnp.cumsum(cwb_m)
+    cum_we = jnp.cumsum(cwe_m)
+
+    # Runs of equal keys: segment bounds via scans (no scatters needed).
     iota = jnp.arange(N3, dtype=i32)
-    run_last = jnp.zeros(N3, dtype=i32).at[run_id].max(iota)
-    run_first = jnp.full(N3, N3, dtype=i32).at[run_id].min(iota)
-    end_idx = run_last[run_id]
-    start_idx = run_first[run_id]
+    is_start = ~same_prev_m
+    ns = lax.cummin(jnp.where(is_start, iota, N3)[::-1])[::-1]
+    next_start = jnp.concatenate([ns[1:], jnp.full(1, N3, dtype=i32)])
+    end_idx = next_start - 1
+    start_idx = lax.cummax(jnp.where(is_start, iota, 0))
 
-    covered = cum_wb[end_idx] > cum_we[end_idx]
-    old_val = hv[jnp.clip(cum_h[end_idx] - 1, 0, C - 1)]
+    at_end = jnp.stack([cum_h, cum_wb, cum_we])[:, end_idx]
+    covered = at_end[1] > at_end[2]
+    old_val = hv[jnp.clip(at_end[0] - 1, 0, C - 1)]
     val = jnp.where(covered, version, old_val)
-    val = jnp.where(val < oldest_eff, jnp.int64(0), val)
+    # Stale clamp + rebase to the new base (= absolute oldest_eff).
+    val = jnp.where(val < oldest_eff, 0, val - oldest_eff)
 
     # Valid points: real history entries + committed write endpoints.
-    valid_pt = (is_h_m | is_wb_m | is_we_m).astype(bool)
-    cum_v = _cumsum_i32(valid_pt.astype(i32))
-    prev_cum = jnp.where(start_idx > 0, cum_v[jnp.maximum(start_idx - 1, 0)], 0)
-    first_valid = valid_pt & (cum_v == prev_cum + 1)
-
-    # Source ids: which row the representative's key lives in.
-    # history j -> j; endpoint p -> C + p.
-    src_m = jnp.zeros(N3, dtype=i32).at[posA].set(jnp.arange(C, dtype=i32))
-    src_m = src_m.at[posB].set(C + jnp.arange(P2, dtype=i32))
+    valid_pt = (is_h_m | cwb_m | cwe_m).astype(i32)
+    cum_v = jnp.cumsum(valid_pt)
+    seg_base = lax.cummax(jnp.where(is_start, cum_v - valid_pt, -1))
+    first_valid = (valid_pt == 1) & (cum_v == seg_base + 1)
 
     # Compaction 1 — scatter run representatives to the front. Destinations
     # are unique; everything else lands in dump slot N3 where .max keeps the
     # result independent of scatter order (determinism).
-    cum_fv = _cumsum_i32(first_valid.astype(i32))
+    cum_fv = jnp.cumsum(first_valid.astype(i32))
     dest1 = jnp.where(first_valid, cum_fv - 1, N3)
     m1 = cum_fv[N3 - 1]
     csrc = jnp.zeros(N3 + 1, dtype=i32).at[dest1].max(src_m)[:N3]
-    cval = jnp.zeros(N3 + 1, dtype=jnp.int64).at[dest1].max(val)[:N3]
+    cval = jnp.zeros(N3 + 1, dtype=i32).at[dest1].max(val)[:N3]
 
     # Coalesce equal adjacent step values.
     in1 = iota < m1
-    prev_val = jnp.concatenate([jnp.full(1, -1, dtype=cval.dtype), cval[:-1]])
+    prev_val = jnp.concatenate([jnp.full(1, -1, dtype=i32), cval[:-1]])
     keep2 = in1 & ((iota == 0) | (cval != prev_val))
-    cum2 = _cumsum_i32(keep2.astype(i32))
+    cum2 = jnp.cumsum(keep2.astype(i32))
     new_n = cum2[N3 - 1]
 
     # Compaction 2 — into the C-capacity state (dump slot C).
     dest2 = jnp.where(keep2, jnp.minimum(cum2 - 1, C), C)
     src2 = jnp.zeros(C + 1, dtype=i32).at[dest2].max(csrc)[:C]
-    hv_new = jnp.zeros(C + 1, dtype=jnp.int64).at[dest2].max(cval)[:C]
+    hv_new = jnp.zeros(C + 1, dtype=i32).at[dest2].max(cval)[:C]
 
-    # Materialize keys for the new state by gathering from history or the
-    # sorted endpoint rows, selected per entry (all 1-D gathers).
-    from_hist = src2 < C
-    hidx = jnp.clip(src2, 0, C - 1)
-    eidx = jnp.clip(src2 - C, 0, P2 - 1)
+    # Materialize keys: src is the column in [history | sorted endpoints],
+    # so ONE 2D gather from the concatenation yields words + len together.
+    all_keys = jnp.concatenate([hkeys, smat], axis=1)
     live = jnp.arange(C, dtype=i32) < new_n
-    out_rows = [
-        jnp.where(
-            live, jnp.where(from_hist, hkw[j][hidx], sew[j][eidx]), PAD_WORD
-        )
-        for j in range(W)
-    ]
-    hkw_out = jnp.stack(out_rows)  # (W, C): large axis minor
-    hkl_out = jnp.where(
-        live, jnp.where(from_hist, hkl[hidx], sel[eidx]), INT32_MAX
+    picked = all_keys[:, jnp.clip(src2, 0, N3 - 1)]
+    pad_col = jnp.concatenate(
+        [jnp.full(W, PAD_WORD, dtype=i32), jnp.full(1, INT32_MAX, dtype=i32)]
     )
-    hv_out = jnp.where(live, hv_new, jnp.int64(0))
+    keys_out = jnp.where(live[None, :], picked, pad_col[:, None])
+    hv_out = jnp.where(live, hv_new, 0)
+    hmat_out = jnp.concatenate([keys_out, hv_out[None, :]], axis=0)
 
     overflow = new_n > C
 
@@ -433,25 +406,80 @@ def _resolve_kernel_impl(
         jnp.int8(TOO_OLD),
         jnp.where(conflict > 0, jnp.int8(CONFLICT), jnp.int8(COMMITTED)),
     )
-    return hkw_out, hkl_out, hv_out, new_n, statuses, overflow
+    aux = jnp.stack([new_n, overflow.astype(i32)])
+    return hmat_out, new_n, statuses, aux
 
 
-# Single-resolver entry point; the sharded multi-resolver path (sharded.py)
-# wraps _resolve_kernel_impl under shard_map instead.
-_resolve_kernel = jax.jit(_resolve_kernel_impl)
+_KERNEL_CACHE: dict = {}
+
+
+def _kernel_for(lay: FusedLayout):
+    key = lay.key()
+    fn = _KERNEL_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(lambda hmat, n, fused: _resolve_kernel_impl(
+            hmat, n, fused, lay=lay
+        ))
+        _KERNEL_CACHE[key] = fn
+    return fn
+
+
+class PendingResolve:
+    """Handle to an in-flight resolve: dispatch returned without any
+    host-device sync; result() performs the (small) D2H reads and the
+    invariant checks."""
+
+    def __init__(self, cs: "ConflictSetTPU", statuses, aux, n_txns: int,
+                 seq: int, extra_snapshot: int):
+        self._cs = cs
+        self._statuses = statuses
+        self._aux = aux
+        self.n_txns = n_txns
+        self._seq = seq
+        self._extra_snapshot = extra_snapshot
+
+    def result(self) -> np.ndarray:
+        st = np.asarray(self._statuses)[: self.n_txns]
+        aux = np.asarray(self._aux)
+        new_n, overflow = int(aux[0]), bool(aux[1])
+        if overflow:  # pragma: no cover - host pre-growth makes this dead
+            # The kernel output (already installed for pipelining) silently
+            # dropped entries past capacity; nothing downstream of it can be
+            # trusted. Poison the set so every later resolve fails fast —
+            # the role above treats this like the reference treats internal
+            # invariant failures: crash and re-recruit (SURVEY §3.3).
+            self._cs._poisoned = True
+            raise RuntimeError(
+                "conflict set overflow despite pre-growth bound "
+                f"(new_n={new_n}, capacity={self._cs.capacity}); "
+                "conflict set is poisoned"
+            )
+        # Refresh the host-side pessimistic bound with this exact count.
+        # Later dispatches may already be in flight: their write
+        # contributions are exactly the cumulative-writes counter minus this
+        # batch's dispatch-time snapshot (the counter is monotone, so
+        # consuming results in any order can never over-subtract). Stale
+        # (out-of-order) results must not regress the refresh.
+        cs = self._cs
+        if self._seq > cs._result_seq:
+            cs._result_seq = self._seq
+            cs._n_known = new_n
+            cs._result_cum = self._extra_snapshot
+        return st
 
 
 class ConflictSetTPU:
     """Device-resident conflict set with the ConflictSetCPU contract.
 
-    State grows by capacity doubling when a batch would overflow; the kernel
-    is pure (state in, state out), so an overflowing attempt is simply
-    retried after the host re-pads the state — results are identical.
+    State: one (n_words+2, capacity) int32 matrix (key words, key length,
+    version offset) plus a live-entry count. Versions are offsets from
+    `oldest_version` (the absolute base, host-tracked as a Python int, so
+    arbitrary 64-bit versions are supported while the device stays int32).
 
-    Large resolves are chunked (see module docstring): chunk caps come from
-    SERVER_KNOBS.TPU_MAX_CHUNK_TXNS / TPU_MAX_CHUNK_RANGES so the set of
-    jit-compiled shapes stays small; warmup() precompiles the configured
-    buckets so no compile ever lands mid-commit.
+    Growth: the host tracks a pessimistic entry bound (each committed write
+    adds at most 2 entries) and pre-grows the state BEFORE dispatch, so a
+    resolve never needs a device round trip to learn about overflow and the
+    dispatch path is fully asynchronous.
     """
 
     def __init__(
@@ -460,87 +488,100 @@ class ConflictSetTPU:
         max_key_bytes: int = 32,
         initial_capacity: int = 1024,
     ):
-        ensure_x64()
-        self.n_words = max(1, (max_key_bytes + 7) // 8)
-        self.max_key_bytes = 8 * self.n_words
+        self.n_words = max(1, (max_key_bytes + 3) // 4)
+        self.max_key_bytes = 4 * self.n_words
         self.capacity = next_pow2(initial_capacity, minimum=64)
-        self.oldest_version = 0
-        # Entry 0 is the empty-key sentinel at init_version (the reference's
-        # skip-list header, SkipList.cpp:497 — baseline for all lookups).
-        hkw = np.full((self.n_words, self.capacity), PAD_WORD, dtype=np.uint64)
-        hkl = np.full(self.capacity, INT32_MAX, dtype=np.int32)
-        hv = np.zeros(self.capacity, dtype=np.int64)
-        hkw[:, 0] = 0
-        hkl[0] = 0
-        hv[0] = init_version
-        self.hkw = jnp.asarray(hkw)
-        self.hkl = jnp.asarray(hkl)
-        self.hv = jnp.asarray(hv)
+        self.oldest_version = 0  # absolute; also the version-offset base
+        if not (0 <= init_version < 2**31):
+            raise ValueError("init_version must fit the initial int32 window")
+        from .packing import empty_state
+
+        self.hmat = jnp.asarray(
+            empty_state(self.n_words, self.capacity, init_version)
+        )
         self.n = jnp.int32(1)
+        self._n_known = 1     # last exact count read back from device
+        self._cum_writes = 0  # 2*writes over ALL dispatches (monotone)
+        self._result_cum = 0  # _cum_writes snapshot at last-applied result
+        self._dispatch_seq = 0
+        self._result_seq = 0
+        self._poisoned = False
 
     def __len__(self) -> int:
         return int(self.n)
 
+    @property
+    def _n_extra(self) -> int:
+        """Entry contributions of batches dispatched but not yet resulted."""
+        return self._cum_writes - self._result_cum
+
+    @property
+    def _n_bound(self) -> int:
+        return min(self.capacity, self._n_known + self._n_extra)
+
     def entries(self) -> list[tuple[bytes, int]]:
-        """Host copy of the live step function (for tests/debugging)."""
+        """Host copy of the live step function, ABSOLUTE versions."""
+        hmat = np.asarray(self.hmat)
         n = int(self.n)
-        hkw = np.asarray(self.hkw)[:, :n]
-        hkl = np.asarray(self.hkl)[:n]
-        hv = np.asarray(self.hv)[:n]
+        W = self.n_words
         out = []
         for i in range(n):
-            kl = int(hkl[i])
-            b = b"".join(int(w).to_bytes(8, "big") for w in hkw[:, i])[:kl]
-            out.append((b, int(hv[i])))
+            b = unpack_key(hmat[:W, i], int(hmat[W, i]))
+            v = int(hmat[W + 1, i])
+            out.append((b, v + self.oldest_version if v > 0 else 0))
         return out
 
     def _grow(self, min_capacity: int) -> None:
+        from .packing import state_pad_block
+
         new_cap = next_pow2(min_capacity, minimum=self.capacity * 2)
         pad = new_cap - self.capacity
-        self.hkw = jnp.concatenate(
-            [self.hkw, jnp.full((self.n_words, pad), PAD_WORD, dtype=jnp.uint64)],
+        self.hmat = jnp.concatenate(
+            [self.hmat, jnp.asarray(state_pad_block(self.n_words, pad))],
             axis=1,
         )
-        self.hkl = jnp.concatenate(
-            [self.hkl, jnp.full(pad, INT32_MAX, dtype=jnp.int32)]
-        )
-        self.hv = jnp.concatenate([self.hv, jnp.zeros(pad, dtype=jnp.int64)])
         self.capacity = new_cap
 
-    def resolve_positioned(
-        self, version: int, new_oldest_version: int, pb: PositionedBatch
-    ):
-        batch = pb.packed
-        oldest_eff = max(self.oldest_version, new_oldest_version)
-        n_writes = int(batch.w_valid.sum())
-        while True:
-            # ">=" keeps at least one +inf pad column in the history at kernel
-            # entry even for read-only batches at n == capacity: _lower_rank's
-            # branchless search saturates at C-1, so a key above every live
-            # entry needs a pad entry to rank against (ADVICE r2 high).
-            if int(self.n) + 2 * n_writes >= self.capacity:
-                self._grow(int(self.n) + 2 * n_writes + 1)
-            out = _resolve_kernel(
-                self.hkw, self.hkl, self.hv, self.n,
-                pb.sew, pb.sel, pb.stag, pb.wsrc, pb.same_ep,
-                pb.q_end, pb.s_end, pb.s_begin, pb.q_begin,
-                pb.lo_r, pb.hi_r, pb.perm_w,
-                batch.rtxn, batch.rsnap, batch.wtxn, batch.w_valid,
-                batch.too_old,
-                jnp.int64(version), jnp.int64(oldest_eff),
+    def resolve_async(
+        self, version: int, new_oldest_version: int, pb: PackedBatch
+    ) -> PendingResolve:
+        if self._poisoned:
+            raise RuntimeError("conflict set is poisoned by a prior overflow")
+        if pb.base != self.oldest_version:
+            raise ValueError(
+                f"batch packed at base {pb.base} but conflict set is at "
+                f"oldest_version {self.oldest_version}"
             )
-            hkw, hkl, hv, new_n, statuses, overflow = out
-            if bool(overflow):
-                self._grow(self.capacity * 2)
-                continue
-            self.hkw, self.hkl, self.hv, self.n = hkw, hkl, hv, new_n
-            self.oldest_version = oldest_eff
-            return statuses
+        oldest_eff = max(self.oldest_version, new_oldest_version)
+        version_off = version - self.oldest_version
+        if not (0 <= version_off < 2**31):
+            raise ValueError(
+                "resolve version outside the int32 window relative to "
+                f"oldest_version {self.oldest_version}"
+            )
+        if pb.layout.n_words != self.n_words:
+            raise ValueError("batch packed with a different key width")
 
-    def resolve_packed(self, version: int, new_oldest_version: int, batch: PackedBatch):
-        return self.resolve_positioned(
-            version, new_oldest_version, position_batch(batch)
+        # Pre-grow from the pessimistic bound so overflow cannot happen.
+        if self._n_bound + 2 * pb.n_writes >= self.capacity:
+            self._grow(self._n_bound + 2 * pb.n_writes + 1)
+
+        pb.set_scalars(version_off, oldest_eff - self.oldest_version)
+        fused_dev = jax.device_put(pb.buf)
+        out = _kernel_for(pb.layout)(self.hmat, self.n, fused_dev)
+        self.hmat, self.n, statuses, aux = out
+        self._cum_writes += 2 * pb.n_writes
+        self._dispatch_seq += 1
+        self.oldest_version = oldest_eff
+        return PendingResolve(
+            self, statuses, aux, pb.n_txns, self._dispatch_seq,
+            self._cum_writes,
         )
+
+    def resolve_packed(
+        self, version: int, new_oldest_version: int, pb: PackedBatch
+    ) -> np.ndarray:
+        return self.resolve_async(version, new_oldest_version, pb).result()
 
     def _chunks(self, txns: Sequence[TxnConflictInfo]):
         """Split a batch into chunks bounded by the knob caps (txn count and
@@ -581,39 +622,25 @@ class ConflictSetTPU:
                 new_oldest_version if last else self.oldest_version,
                 batch,
             )
-            statuses.extend(int(s) for s in np.asarray(st)[: batch.n_txns])
+            statuses.extend(int(s) for s in st)
         return ConflictBatchResult(statuses)
 
     def warmup(self, shapes: Sequence[tuple[int, int, int]] | None = None) -> None:
         """Precompile the kernel for the given (n_txns, n_reads, n_writes)
         padded buckets (default: SERVER_KNOBS.TPU_BATCH_BUCKETS with the
         typical 5-read/2-write footprint) at the current capacity, so no XLA
-        compile ever lands on the commit path (VERDICT r1 weak #3)."""
+        compile ever lands on the commit path."""
         from ..core.knobs import SERVER_KNOBS
 
         if shapes is None:
             shapes = [(b, 5 * b, 2 * b) for b in SERVER_KNOBS.TPU_BATCH_BUCKETS]
-        saved = (self.hkw, self.hkl, self.hv, self.n, self.oldest_version)
+        saved = (self.hmat, self.n, self._n_known, self._cum_writes,
+                 self._result_cum, self.oldest_version)
         for (t, r, w) in shapes:
-            batch = _dummy_batch(t, r, w, self.n_words)
-            self.resolve_packed(0, 0, batch)
-            self.hkw, self.hkl, self.hv, self.n, self.oldest_version = saved
-
-
-def _dummy_batch(n_txns: int, n_reads: int, n_writes: int, n_words: int) -> PackedBatch:
-    """A padded all-invalid batch of the given bucket shape (for warmup)."""
-    R = next_pow2(n_reads)
-    Wr = next_pow2(n_writes)
-    T = next_pow2(n_txns)
-    pw = lambda cap: np.full((cap, n_words), PAD_WORD, dtype=np.uint64)
-    pl = lambda cap: np.full(cap, INT32_MAX, dtype=np.int32)
-    return PackedBatch(
-        n_txns=0,
-        rbw=pw(R), rbl=pl(R), rew=pw(R), rel=pl(R),
-        rtxn=np.zeros(R, dtype=np.int32),
-        rsnap=np.full(R, np.int64(2**62), dtype=np.int64),
-        wbw=pw(Wr), wbl=pl(Wr), wew=pw(Wr), wel=pl(Wr),
-        wtxn=np.zeros(Wr, dtype=np.int32),
-        w_valid=np.zeros(Wr, dtype=bool),
-        too_old=np.zeros(T, dtype=bool),
-    )
+            batch = pack_batch(
+                [], self.oldest_version, self.n_words,
+                caps=(max(r, 1), max(w, 1), max(t, 1)),
+            )
+            self.resolve_packed(self.oldest_version, 0, batch)
+            (self.hmat, self.n, self._n_known, self._cum_writes,
+             self._result_cum, self.oldest_version) = saved
