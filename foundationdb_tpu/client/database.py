@@ -1,0 +1,54 @@
+"""Database handle + the transactional retry loop.
+
+(ref: Database/Cluster bootstrap, fdbclient/NativeAPI.actor.cpp:528,732;
+the retry loop is the contract every binding exposes as
+`@fdb.transactional`, bindings/python/fdb/impl.py.)
+"""
+
+from __future__ import annotations
+
+from typing import Awaitable, Callable, Optional, TypeVar
+
+from .transaction import Transaction
+
+T = TypeVar("T")
+
+
+class Database:
+    def __init__(self, cluster):
+        self.cluster = cluster
+
+    def create_transaction(self) -> Transaction:
+        return Transaction(self)
+
+    async def transact(
+        self, fn: Callable[[Transaction], Awaitable[T]], max_retries: int = 1000
+    ) -> T:
+        """Run `fn` in a transaction with the standard retry loop: commit,
+        and on a retryable error back off, reset and run again (ref:
+        @fdb.transactional / Transaction::onError)."""
+        tr = self.create_transaction()
+        for _ in range(max_retries):
+            try:
+                result = await fn(tr)
+                await tr.commit()
+                return result
+            except BaseException as e:  # noqa: BLE001 — on_error re-raises
+                await tr.on_error(e)
+        raise RuntimeError(f"transact: exhausted {max_retries} retries")
+
+    # -- convenience single-op helpers --
+    async def get(self, key: bytes) -> Optional[bytes]:
+        return await self.transact(lambda tr: tr.get(key))
+
+    async def set(self, key: bytes, value: bytes) -> None:
+        async def body(tr: Transaction):
+            tr.set(key, value)
+
+        await self.transact(body)
+
+    async def clear(self, key: bytes) -> None:
+        async def body(tr: Transaction):
+            tr.clear(key)
+
+        await self.transact(body)

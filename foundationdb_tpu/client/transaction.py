@@ -1,0 +1,382 @@
+"""Transaction: snapshot reads + buffered writes + OCC commit.
+
+Maps the reference's two client layers into one class:
+
+- NativeAPI `Transaction` (fdbclient/NativeAPI.actor.cpp:1815): GRV on
+  first read (:2700 readVersionBatcher), reads at that version against
+  storage (:1146 getValue, :1603 getRange), commit submission (:2571
+  commit -> :2363 tryCommit), and the retry loop (:2796 onError —
+  not_committed / transaction_too_old / commit_unknown_result back off and
+  retry, everything else re-raises).
+- ReadYourWrites (fdbclient/ReadYourWrites.actor.cpp WriteMap/RYWIterator):
+  reads observe the transaction's own uncommitted writes; atomic ops stack;
+  clears tombstone; range reads merge the write overlay with storage.
+
+Conflict bookkeeping follows the reference exactly: every non-snapshot
+point read adds [key, key+\\x00) and every non-snapshot range read adds the
+range actually read to the read-conflict set; mutations imply their write
+ranges (derived proxy-side from the mutation list, equivalent to the
+client-side write-conflict ranges the reference sends)."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ..core.errors import (
+    InvertedRange,
+    KeyTooLarge,
+    TransactionCancelled,
+    TransactionTooLarge,
+    UsedDuringCommit,
+    ValueTooLarge,
+    is_retryable,
+)
+from ..core.knobs import CLIENT_KNOBS
+from ..core.runtime import Future, current_loop, spawn
+from ..kv.atomic import MutationType, apply_atomic
+from ..kv.keys import KeyRange, key_after
+from ..cluster.interfaces import (
+    CommitTransactionRequest,
+    GetRangeRequest,
+    GetReadVersionRequest,
+    GetValueRequest,
+    Mutation,
+    WatchValueRequest,
+)
+
+
+class _WriteEntry:
+    """RYW index entry for one key: either a definite value (set/clear) or
+    a stack of atomic ops over an unknown base (ref: WriteMap's
+    OperationStack, fdbclient/ReadYourWrites.h / WriteMap.h:119)."""
+
+    __slots__ = ("known", "value", "ops", "cleared_base")
+
+    def __init__(self):
+        self.known = False
+        self.value: Optional[bytes] = None
+        self.ops: list[tuple[MutationType, bytes]] = []
+        self.cleared_base = False
+
+    def set(self, value: Optional[bytes]):
+        self.known = True
+        self.value = value
+        self.ops = []
+
+    def atomic(self, op: MutationType, param: bytes):
+        if self.known:
+            self.value = apply_atomic(op, self.value, param)
+        else:
+            self.ops.append((op, param))
+
+    def resolve(self, base: Optional[bytes]) -> Optional[bytes]:
+        if self.known:
+            return self.value
+        v = None if self.cleared_base else base
+        for op, param in self.ops:
+            v = apply_atomic(op, v, param)
+        return v
+
+
+class Transaction:
+    def __init__(self, db):
+        self._db = db
+        self._reset()
+
+    def _reset(self):
+        self._read_version_f: Optional[Future] = None
+        self._writes: dict[bytes, _WriteEntry] = {}
+        self._clears: list[KeyRange] = []
+        self._mutation_log: list[Mutation] = []
+        self._read_conflicts: list[KeyRange] = []
+        self._extra_write_conflicts: list[KeyRange] = []
+        self._size_bytes = 0
+        self._committed_version: Optional[int] = None
+        self._commit_outstanding = False
+        self._cancelled = False
+        self._backoff = CLIENT_KNOBS.DEFAULT_BACKOFF
+        self._watch_list: list = []
+
+    # -- versions --
+    def get_read_version(self) -> Future:
+        """GRV; batched proxy-side (ref: readVersionBatcher :2700)."""
+        self._check_usable()
+        if self._read_version_f is None:
+            req = GetReadVersionRequest()
+            self._db.cluster.proxy.grv_stream.send(req)
+            self._read_version_f = req.reply.future
+        return self._read_version_f
+
+    def set_read_version(self, version: int) -> None:
+        from ..core.runtime import ready_future
+
+        self._read_version_f = ready_future(version)
+
+    # -- checks --
+    def _check_usable(self):
+        if self._cancelled:
+            raise TransactionCancelled()
+        if self._commit_outstanding:
+            raise UsedDuringCommit()
+
+    def _check_key(self, key: bytes):
+        limit = CLIENT_KNOBS.KEY_SIZE_LIMIT
+        # The deployment's resolver may pack keys at a narrower fixed width
+        # (ConflictSetTPU.max_key_bytes); admission happens here, client
+        # side, exactly where the reference rejects key_too_large
+        # (fdbclient/NativeAPI.actor.cpp Transaction::set).
+        width = getattr(self._db.cluster.resolver.cs, "max_key_bytes", None)
+        if width is not None:
+            limit = min(limit, width)
+        if len(key) > limit:
+            raise KeyTooLarge(f"key of {len(key)} bytes exceeds limit {limit}")
+
+    # -- reads --
+    async def get(self, key: bytes, snapshot: bool = False) -> Optional[bytes]:
+        self._check_usable()
+        self._check_key(key)
+        entry = self._writes.get(key)
+        if entry is not None and entry.known:
+            return entry.value
+        if entry is None and self._covered_by_clear(key):
+            return None
+        version = await self.get_read_version()
+        if not snapshot:
+            self._read_conflicts.append(KeyRange(key, key_after(key)))
+        if entry is None:
+            req = GetValueRequest(key, version)
+            return await self._db.cluster.storage.get_value(req)
+        # Atomic stack over an unread base: fetch base and fold.
+        base = None
+        if not entry.cleared_base and not self._covered_by_clear(key):
+            base = await self._db.cluster.storage.get_value(
+                GetValueRequest(key, version)
+            )
+        return entry.resolve(base)
+
+    async def get_range(
+        self,
+        begin: bytes,
+        end: bytes,
+        limit: int = 0,
+        reverse: bool = False,
+        snapshot: bool = False,
+    ) -> list[tuple[bytes, bytes]]:
+        self._check_usable()
+        if begin > end:
+            raise InvertedRange()
+        version = await self.get_read_version()
+        overlay = any(begin <= k < end for k in self._writes) or any(
+            c.intersects(KeyRange(begin, end)) for c in self._clears
+        )
+        if not overlay:
+            # Fast path: no local writes in range — the storage scan can be
+            # clipped to the caller's limit/direction directly (the
+            # reference clips server-side the same way).
+            req = GetRangeRequest(begin, end, version, limit, reverse)
+            rows = await self._db.cluster.storage.get_range(req)
+        else:
+            # RYW merge: an uncommitted overlay can hide or add rows, so
+            # the limit can only be applied after merging; scan unclipped.
+            req = GetRangeRequest(begin, end, version, limit=0, reverse=False)
+            stored = await self._db.cluster.storage.get_range(req)
+            merged: dict[bytes, Optional[bytes]] = {}
+            for k, v in stored:
+                if not self._covered_by_clear(k):
+                    merged[k] = v
+            for k, entry in self._writes.items():
+                if begin <= k < end:
+                    if entry.known:
+                        merged[k] = entry.value
+                    else:
+                        merged[k] = entry.resolve(merged.get(k))
+            rows = sorted(
+                ((k, v) for k, v in merged.items() if v is not None),
+                reverse=reverse,
+            )
+            if limit:
+                rows = rows[:limit]
+        if not snapshot:
+            # Conflict on the range actually read (ref: RYW adds the
+            # clipped range when a limit stops the scan early).
+            if limit and len(rows) == limit:
+                if reverse:
+                    self._read_conflicts.append(KeyRange(rows[-1][0], end))
+                else:
+                    self._read_conflicts.append(
+                        KeyRange(begin, key_after(rows[-1][0]))
+                    )
+            else:
+                self._read_conflicts.append(KeyRange(begin, end))
+        return rows
+
+    def _covered_by_clear(self, key: bytes) -> bool:
+        return any(c.contains(key) for c in self._clears)
+
+    # -- writes --
+    def _entry(self, key: bytes) -> _WriteEntry:
+        e = self._writes.get(key)
+        if e is None:
+            e = self._writes[key] = _WriteEntry()
+        return e
+
+    def _log(self, m: Mutation):
+        self._size_bytes += len(m.param1) + len(m.param2)
+        if self._size_bytes > CLIENT_KNOBS.TRANSACTION_SIZE_LIMIT:
+            raise TransactionTooLarge()
+        self._mutation_log.append(m)
+
+    def set(self, key: bytes, value: bytes) -> None:
+        self._check_usable()
+        self._check_key(key)
+        if len(value) > CLIENT_KNOBS.VALUE_SIZE_LIMIT:
+            raise ValueTooLarge(f"value of {len(value)} bytes")
+        self._log(Mutation(MutationType.SET_VALUE, key, value))
+        self._entry(key).set(value)
+
+    def clear(self, key: bytes) -> None:
+        self.clear_range(key, key_after(key))
+
+    def clear_range(self, begin: bytes, end: bytes) -> None:
+        self._check_usable()
+        self._check_key(begin)
+        self._check_key(end)
+        if begin > end:
+            raise InvertedRange()
+        if begin == end:
+            return
+        self._log(Mutation(MutationType.CLEAR_RANGE, begin, end))
+        for k in [k for k in self._writes if begin <= k < end]:
+            del self._writes[k]
+        self._clears.append(KeyRange(begin, end))
+
+    def atomic_op(self, op: MutationType, key: bytes, param: bytes) -> None:
+        self._check_usable()
+        self._check_key(key)
+        if op in (MutationType.SET_VALUE, MutationType.CLEAR_RANGE):
+            raise ValueError("use set()/clear_range() for plain mutations")
+        self._log(Mutation(op, key, param))
+        e = self._writes.get(key)
+        if e is None:
+            e = self._entry(key)
+            if self._covered_by_clear(key):
+                e.cleared_base = True
+        e.atomic(op, param)
+
+    def add(self, key: bytes, param: bytes) -> None:
+        self.atomic_op(MutationType.ADD_VALUE, key, param)
+
+    # -- conflict ranges (ref: tr.add_read/write_conflict_range) --
+    def add_read_conflict_range(self, begin: bytes, end: bytes) -> None:
+        self._read_conflicts.append(KeyRange(begin, end))
+
+    def add_read_conflict_key(self, key: bytes) -> None:
+        self.add_read_conflict_range(key, key_after(key))
+
+    def add_write_conflict_range(self, begin: bytes, end: bytes) -> None:
+        self._extra_write_conflicts.append(KeyRange(begin, end))
+
+    def add_write_conflict_key(self, key: bytes) -> None:
+        self.add_write_conflict_range(key, key_after(key))
+
+    # -- watches --
+    def watch(self, key: bytes) -> "_PendingWatch":
+        """Watch armed at commit with the transaction's view of the value
+        (ref: Transaction::watch + watchValue :1292). Watches belong to one
+        commit ATTEMPT: reset()/on_error() drops unarmed watches, exactly
+        like the reference cancels them when the transaction resets."""
+        self._check_usable()
+        w = _PendingWatch(self._db, key)
+        self._watch_list.append(w)
+        return w
+
+    # -- commit / retry --
+    async def commit(self) -> int:
+        """Resolves with the commit version; raises NotCommitted on
+        conflict (ref: Transaction::commit :2571)."""
+        self._check_usable()
+        if self._committed_version is not None:
+            return self._committed_version
+        if not self._mutation_log and not self._extra_write_conflicts:
+            # Read-only transactions commit trivially at their snapshot
+            # (ref: tryCommit fast path).
+            rv = 0
+            if self._read_version_f is not None:
+                rv = await self._read_version_f
+            self._committed_version = rv
+            await self._arm_watches(rv)
+            return rv
+        snapshot = 0
+        if self._read_conflicts:
+            snapshot = await self.get_read_version()
+        req = CommitTransactionRequest(
+            read_snapshot=snapshot,
+            read_conflict_ranges=tuple(self._read_conflicts),
+            write_conflict_ranges=tuple(self._extra_write_conflicts),
+            mutations=tuple(self._mutation_log),
+        )
+        self._commit_outstanding = True
+        try:
+            self._db.cluster.proxy.commit_stream.send(req)
+            commit_id = await req.reply.future
+        finally:
+            self._commit_outstanding = False
+        self._committed_version = commit_id.version
+        await self._arm_watches(commit_id.version)
+        return commit_id.version
+
+    async def _arm_watches(self, version: int) -> None:
+        for w in self._watch_list:
+            value = await self.get(w.key, snapshot=True)
+            w._arm(version, value)
+        self._watch_list = []
+
+    async def on_error(self, err: BaseException) -> None:
+        """Backoff-and-reset for retryable errors, re-raise otherwise
+        (ref: Transaction::onError :2796)."""
+        if not is_retryable(err):
+            raise err
+        loop = current_loop()
+        backoff = self._backoff
+        self._reset_for_retry(backoff)
+        await loop.delay(backoff * (0.5 + loop.random.random01()))
+
+    def _reset_for_retry(self, prev_backoff: float) -> None:
+        self._reset()
+        self._backoff = min(
+            prev_backoff * CLIENT_KNOBS.BACKOFF_GROWTH_RATE,
+            CLIENT_KNOBS.DEFAULT_MAX_BACKOFF,
+        )
+
+    def reset(self) -> None:
+        self._reset()
+
+    def cancel(self) -> None:
+        self._cancelled = True
+
+
+class _PendingWatch:
+    """Client handle for a watch; becomes a live storage watch after the
+    owning transaction commits."""
+
+    def __init__(self, db, key: bytes):
+        self._db = db
+        self.key = key
+        self._future: Optional[Future] = None
+        from ..core.runtime import Promise
+
+        self._ready = Promise()
+
+    def _arm(self, version: int, value: Optional[bytes]) -> None:
+        req = WatchValueRequest(self.key, value, version)
+
+        async def run():
+            return await self._db.cluster.storage.watch_value(req)
+
+        task = spawn(run(), name=f"watch:{self.key!r}")
+        self._ready.send(task.done)
+
+    async def wait(self) -> int:
+        """Resolves with the version at which the value changed."""
+        inner = await self._ready.future
+        return await inner

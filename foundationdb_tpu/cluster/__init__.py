@@ -1,0 +1,14 @@
+"""Single-process transaction-system roles wired on the deterministic loop.
+
+This is SURVEY.md §7 step 3 — the minimum end-to-end slice: a version
+authority (master), a batching commit proxy, a resolver role over the
+ConflictSet kernel, an in-memory tag log, and an MVCC storage node, all as
+actors on `foundationdb_tpu.core`'s event loop, with the client API in
+`foundationdb_tpu.client` driving them. Role boundaries and message types
+mirror the reference's interfaces (fdbclient/MasterProxyInterface.h,
+StorageServerInterface.h, fdbserver/ResolverInterface.h) so that the
+networked/multi-process tier can later swap PromiseStream endpoints for
+real RPC without touching role logic.
+"""
+
+from .cluster import LocalCluster  # noqa: F401

@@ -1,0 +1,54 @@
+"""Generic request batcher (ref: fdbrpc/batcher.actor.h:29-60).
+
+Collects items from a PromiseStream into batches closed by (a) item count,
+(b) accumulated bytes, or (c) a deadline measured from the first item — the
+same three triggers the reference's proxy uses to shape commit batches for
+the resolver. For the TPU resolver the count trigger is what builds
+accelerator-sized batches (SURVEY.md north star: the batcher is tuned to
+feed the kernel 64K-class chunks)."""
+
+from __future__ import annotations
+
+from typing import Awaitable, Callable
+
+from ..core.actors import PromiseStream, timeout
+from ..core.runtime import TaskPriority, current_loop
+
+
+async def batcher(
+    stream: PromiseStream,
+    on_batch: Callable[[list], None],
+    *,
+    interval: float,
+    max_count: int = 1 << 30,
+    max_bytes: int = 1 << 62,
+    bytes_of: Callable[[object], int] = lambda _: 1,
+    priority: int = TaskPriority.PROXY_COMMIT,
+):
+    """Forever: gather a batch and hand it to on_batch (which typically
+    spawns the per-batch actor so batching continues concurrently)."""
+    loop = current_loop()
+    sentinel = object()
+    while True:
+        first = await stream.pop()
+        batch = [first]
+        size = bytes_of(first)
+        deadline = loop.now() + interval
+        while size < max_bytes and len(batch) < max_count:
+            remaining = deadline - loop.now()
+            if remaining <= 0:
+                break
+            pop_f = stream.pop()
+            nxt = await timeout(pop_f, remaining, default=sentinel)
+            if nxt is sentinel:
+                # The pop raced the deadline: if its value ever arrives,
+                # refund it to the stream front so nothing is lost.
+                pop_f.add_callback(
+                    lambda f: stream.unpop(f._value) if f.is_set() else None
+                )
+                break
+            batch.append(nxt)
+            size += bytes_of(nxt)
+        on_batch(batch)
+        # Yield so the spawned batch actor starts before the next gather.
+        await loop.yield_(priority)

@@ -1,0 +1,50 @@
+"""One-process cluster wiring (SURVEY.md §7 step 3).
+
+Builds the full commit path — master (version authority) -> proxy (batcher
++ 5-phase pipeline) -> resolver role (over a pluggable ConflictSet backend)
+-> memory tlog -> MVCC storage — on the current deterministic event loop
+and hands back a `Database` client. With the default CPU conflict set this
+runs entirely under simulation; passing a ConflictSetTPU instance runs the
+identical system with conflict detection on the device (the integration the
+BASELINE north star describes: the kernel behind the same interface, fed by
+the proxy's commit batcher).
+"""
+
+from __future__ import annotations
+
+from ..resolver.cpu import ConflictSetCPU
+from .master import Master
+from .proxy import CommitProxy
+from .resolver_role import ResolverRole
+from .storage import StorageServer
+from .tlog import MemoryTLog
+
+
+class LocalCluster:
+    def __init__(self, conflict_set=None, init_version: int = 0):
+        self.master = Master(init_version)
+        self.resolver = ResolverRole(
+            conflict_set if conflict_set is not None else ConflictSetCPU(init_version),
+            init_version,
+        )
+        self.tlog = MemoryTLog(init_version)
+        self.storage = StorageServer(self.tlog, init_version)
+        self.proxy = CommitProxy(self.master, self.resolver, self.tlog)
+        self._started = False
+
+    def start(self) -> "LocalCluster":
+        assert not self._started
+        self._started = True
+        self.storage.start()
+        self.proxy.start()
+        return self
+
+    def stop(self) -> None:
+        self.proxy.stop()
+        self.storage.stop()
+        self._started = False
+
+    def database(self):
+        from ..client.database import Database
+
+        return Database(self)

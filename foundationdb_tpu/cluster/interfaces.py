@@ -1,0 +1,98 @@
+"""Role interfaces: request/reply message types + in-process endpoints.
+
+Mirrors the reference's interface headers (fdbclient/MasterProxyInterface.h:
+33-36 commit/getConsistentReadVersion, fdbclient/StorageServerInterface.h:31
+getValue/getKeyValues/watchValue, fdbserver/ResolverInterface.h:27
+resolve). An endpoint here is a PromiseStream of requests carrying a reply
+Promise — the exact shape FlowTransport serializes over TCP
+(fdbrpc/fdbrpc.h:212 RequestStream / ReplyPromise); the networked tier
+replaces the stream transport, not the message types.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from ..core.runtime import Promise
+from ..kv.atomic import MutationType
+from ..kv.keys import KeyRange
+
+
+@dataclass
+class Mutation:
+    """(ref: MutationRef, fdbclient/CommitTransaction.h:89)."""
+
+    type: MutationType
+    param1: bytes  # key, or range begin for CLEAR_RANGE
+    param2: bytes  # value / atomic operand, or range end for CLEAR_RANGE
+
+
+@dataclass
+class GetReadVersionRequest:
+    """(ref: GetReadVersionRequest, MasterProxyInterface.h:122)."""
+
+    reply: Promise = field(default_factory=Promise)
+
+
+@dataclass
+class CommitTransactionRequest:
+    """(ref: CommitTransactionRequest, MasterProxyInterface.h:76; the
+    payload is CommitTransactionRef, CommitTransaction.h:89-105)."""
+
+    read_snapshot: int
+    read_conflict_ranges: Sequence[KeyRange]
+    write_conflict_ranges: Sequence[KeyRange]
+    mutations: Sequence[Mutation]
+    reply: Promise = field(default_factory=Promise)
+
+
+@dataclass
+class CommitID:
+    """(ref: CommitID, MasterProxyInterface.h:60)."""
+
+    version: int
+
+
+@dataclass
+class GetValueRequest:
+    """(ref: GetValueRequest, StorageServerInterface.h:87)."""
+
+    key: bytes
+    version: int
+    reply: Promise = field(default_factory=Promise)
+
+
+@dataclass
+class GetRangeRequest:
+    """(ref: GetKeyValuesRequest, StorageServerInterface.h:128)."""
+
+    begin: bytes
+    end: bytes
+    version: int
+    limit: int = 0
+    reverse: bool = False
+    reply: Promise = field(default_factory=Promise)
+
+
+@dataclass
+class WatchValueRequest:
+    """(ref: WatchValueRequest, StorageServerInterface.h:110). Fires when
+    the key's value is observed to differ from `value` at some version >
+    `version`."""
+
+    key: bytes
+    value: Optional[bytes]
+    version: int
+    reply: Promise = field(default_factory=Promise)
+
+
+@dataclass
+class ResolveTransactionBatchRequest:
+    """(ref: ResolveTransactionBatchRequest, ResolverInterface.h:70)."""
+
+    prev_version: int
+    version: int
+    last_receive_version: int
+    transactions: list  # list[TxnConflictInfo]
+    reply: Promise = field(default_factory=Promise)

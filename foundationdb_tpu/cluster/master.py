@@ -1,0 +1,58 @@
+"""Master: the version authority (ref: fdbserver/masterserver.actor.cpp).
+
+Assigns each commit batch a half-open version window (prevVersion, version]
+(getVersion :763-830): versions advance with wall/sim time at
+VERSIONS_PER_SECOND so the MVCC window measured in versions corresponds to
+real seconds (fdbserver/Knobs.cpp:59), and every batch learns the previous
+batch's version so downstream roles (resolver, tlog) can enforce total
+commit order by (prevVersion -> version) chaining.
+
+Also tracks the cluster's committed version for GRV
+(getLiveCommittedVersion, MasterProxyServer.actor.cpp:875 asks the master).
+"""
+
+from __future__ import annotations
+
+from ..core.actors import NotifiedVersion
+from ..core.knobs import SERVER_KNOBS
+from ..core.runtime import buggify, current_loop
+from ..core.trace import TraceEvent
+
+
+class Master:
+    def __init__(self, init_version: int = 0):
+        self.version = init_version        # last assigned commit version
+        self.committed = NotifiedVersion(init_version)  # durable + reported
+        self._reference_time = None        # (time, version) anchor
+
+    def get_commit_version(self) -> tuple[int, int]:
+        """(prevVersion, version] window for one commit batch."""
+        loop = current_loop()
+        prev = self.version
+        if self._reference_time is None:
+            self._reference_time = (loop.now(), self.version)
+        t0, v0 = self._reference_time
+        target = v0 + int(
+            (loop.now() - t0) * SERVER_KNOBS.VERSIONS_PER_SECOND
+        )
+        # At least +1; at most MAX_VERSIONS_IN_FLIGHT ahead of committed
+        # (ref: getVersion clamps against MAX_READ_TRANSACTION_LIFE_VERSIONS
+        # per batch, masterserver.actor.cpp:784-800).
+        step = max(1, target - self.version)
+        if buggify("master_version_jump"):
+            step += SERVER_KNOBS.MAX_READ_TRANSACTION_LIFE_VERSIONS // 2
+        step = min(step, SERVER_KNOBS.MAX_READ_TRANSACTION_LIFE_VERSIONS)
+        self.version = prev + step
+        TraceEvent("MasterGetVersion").detail("Version", self.version).log()
+        return prev, self.version
+
+    def report_committed(self, version: int) -> None:
+        """Proxy reports a batch fully durable (ref: updateCommittedVersion
+        path via masterProxyServerCore)."""
+        if version > self.committed.get():
+            self.committed.set(version)
+
+    def get_live_committed_version(self) -> int:
+        """(ref: getLiveCommittedVersion, masterserver.actor.cpp:830 +
+        MasterProxyServer.actor.cpp:875)."""
+        return self.committed.get()

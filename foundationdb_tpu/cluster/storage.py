@@ -1,0 +1,122 @@
+"""MVCC storage server (ref: fdbserver/storageserver.actor.cpp).
+
+Pulls the mutation stream from the tlog (`update`, :2321 — the ingest
+loop), applies it into the VersionedMap window (`applyMutation`, :2232 /
+StorageUpdater), answers reads at versions (`getValueQ` :680 with
+`waitForVersion` :627), fires watches (`watchValue_impl` :758, triggered at
+:1588-1594), and trims the window as durability advances (`updateStorage`
+:2536 + `forget_before` ≙ PTree forgetVersionsBefore).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..core.actors import NotifiedVersion, PromiseStream
+from ..core.errors import TransactionTooOld
+from ..core.knobs import SERVER_KNOBS
+from ..core.runtime import TaskPriority, buggify, current_loop, spawn
+from ..core.trace import TraceEvent
+from ..kv.atomic import MutationType, apply_atomic
+from ..kv.versioned_map import VersionedMap
+from .interfaces import GetRangeRequest, GetValueRequest, Mutation, WatchValueRequest
+from .tlog import MemoryTLog
+
+
+class StorageServer:
+    def __init__(self, tlog: MemoryTLog, init_version: int = 0):
+        self.tlog = tlog
+        self.data = VersionedMap()
+        self.version = NotifiedVersion(init_version)  # applied through here
+        self.oldest_version = init_version
+        self._watches: list[WatchValueRequest] = []
+        self._update_task = None
+
+    def start(self) -> None:
+        self._update_task = spawn(
+            self._update_loop(), TaskPriority.STORAGE, name="storage_update"
+        )
+
+    def stop(self) -> None:
+        if self._update_task is not None:
+            self._update_task.cancel()
+
+    # -- ingest (ref: update :2321) --
+    async def _update_loop(self):
+        loop = current_loop()
+        while True:
+            entries = await self.tlog.peek(self.version.get())
+            for version, mutations in entries:
+                if buggify("storage_slow_apply"):
+                    await loop.delay(0.05 * loop.random.random01())
+                for m in mutations:
+                    self._apply(m, version)
+                self.version.set(version)
+                self._trigger_watches(version)
+            # Window maintenance: keep MVCC history for the read-life window
+            # behind the applied version, then let the log discard.
+            new_oldest = max(
+                self.oldest_version,
+                self.version.get()
+                - SERVER_KNOBS.MAX_READ_TRANSACTION_LIFE_VERSIONS,
+            )
+            if new_oldest > self.oldest_version:
+                self.oldest_version = new_oldest
+                self.data.forget_before(new_oldest)
+            self.tlog.pop(self.version.get())
+
+    def _apply(self, m: Mutation, version: int) -> None:
+        if m.type == MutationType.SET_VALUE:
+            self.data.set(m.param1, m.param2, version)
+        elif m.type == MutationType.CLEAR_RANGE:
+            self.data.clear_range(m.param1, m.param2, version)
+        else:
+            old = self.data.get(m.param1, version)
+            new = apply_atomic(m.type, old, m.param2)
+            if new is None:
+                self.data.clear(m.param1, version)
+            else:
+                self.data.set(m.param1, new, version)
+
+    def _trigger_watches(self, version: int) -> None:
+        if not self._watches:
+            return
+        still = []
+        for w in self._watches:
+            if w.reply.is_set():
+                continue
+            cur = self.data.get(w.key, version)
+            if cur != w.value:
+                w.reply.send(version)
+            else:
+                still.append(w)
+        self._watches = still
+
+    # -- reads (ref: getValueQ :680) --
+    async def _wait_for_version(self, version: int) -> None:
+        """(ref: waitForVersion :627). Blocks until the node catches up; a
+        read below the window raises TransactionTooOld (:634)."""
+        if version < self.oldest_version:
+            raise TransactionTooOld()
+        await self.version.when_at_least(version)
+
+    async def get_value(self, req: GetValueRequest) -> Optional[bytes]:
+        await self._wait_for_version(req.version)
+        return self.data.get(req.key, req.version)
+
+    async def get_range(self, req: GetRangeRequest):
+        await self._wait_for_version(req.version)
+        return self.data.get_range(
+            req.begin, req.end, req.version, req.limit, req.reverse
+        )
+
+    async def watch_value(self, req: WatchValueRequest) -> int:
+        """Resolves with the version at which the value was seen to differ
+        (ref: watchValue_impl :758)."""
+        await self._wait_for_version(req.version)
+        cur = self.data.get(req.key, self.version.get())
+        if cur != req.value:
+            return self.version.get()
+        self._watches.append(req)
+        TraceEvent("StorageWatchStarted").detail("Key", req.key).log()
+        return await req.reply.future

@@ -1,0 +1,69 @@
+"""In-memory transaction log (ref: fdbserver/TLogServer.actor.cpp).
+
+Holds the committed mutation stream in version order; storage servers pull
+from it (peek, :903) and advance their popped version (pop, :861). Commits
+chain by (prevVersion -> version) exactly like tLogCommit :1115 — a commit
+for version v waits until v's predecessor is durable, so the durable prefix
+is always contiguous.
+
+This is the memory tier; the durable DiskQueue-backed tier
+(fdbserver/DiskQueue.actor.cpp two-file design) layers underneath it via
+the storage engine work (SURVEY §7 step 4) without changing this interface.
+"""
+
+from __future__ import annotations
+
+from ..core.actors import NotifiedVersion
+from ..core.runtime import buggify, current_loop
+from ..core.trace import TraceEvent
+
+
+class MemoryTLog:
+    def __init__(self, init_version: int = 0):
+        self._entries: list[tuple[int, list]] = []  # (version, mutations)
+        self.version = NotifiedVersion(init_version)   # highest received
+        self.durable = NotifiedVersion(init_version)   # highest "fsynced"
+        self.popped = init_version
+
+    async def commit(self, prev_version: int, version: int, mutations: list):
+        """Append one batch's mutations; resolves when durable (ref:
+        tLogCommit waits version order then fsyncs via DiskQueue)."""
+        await self.version.when_at_least(prev_version)
+        if self.version.get() == prev_version:
+            # Sole appender for this version window. Empty batches are
+            # logged too: version advances must reach storage servers or a
+            # GRV at the new committed version could never be served (the
+            # reference's proxies push every batch, even empty, so tlog
+            # cursors carry the version stream — commitBatch :800).
+            self._entries.append((version, mutations))
+            self.version.set(version)
+        if buggify("tlog_slow_fsync"):
+            await current_loop().delay(0.1 * current_loop().random.random01())
+        await self.durable.when_at_least(prev_version)
+        if self.durable.get() == prev_version:
+            self.durable.set(version)
+            TraceEvent("TLogCommitDurable").detail("Version", version).log()
+        await self.durable.when_at_least(version)
+
+    async def peek(self, from_version: int) -> list[tuple[int, list]]:
+        """All DURABLE entries with version > from_version; awaits until at
+        least one exists (ref: tLogPeekMessages blocking peek). Non-durable
+        entries are invisible: storage must never apply (and e.g. fire
+        watches for) a commit that could still be lost, or a reader could
+        observe a commit before its client's commit() resolves."""
+        while True:
+            d = self.durable.get()
+            out = [e for e in self._entries if from_version < e[0] <= d]
+            if out:
+                return out
+            await self.durable.when_at_least(
+                max(d, from_version) + 1
+            )
+
+    def pop(self, upto_version: int) -> None:
+        """Storage acknowledges durability through upto_version; the log can
+        discard that prefix (ref: tLogPop)."""
+        if upto_version <= self.popped:
+            return
+        self.popped = upto_version
+        self._entries = [e for e in self._entries if e[0] > upto_version]

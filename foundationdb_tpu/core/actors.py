@@ -155,6 +155,20 @@ class PromiseStream(Generic[T]):
         self._waiters.append(p)
         return p.future
 
+    def unpop(self, value: T) -> None:
+        """Return a value to the FRONT of the stream (a consumer that gave
+        up on a pop — e.g. a batch deadline — puts the eventually-delivered
+        value back so it is the next one popped). Single-consumer pattern:
+        with concurrent poppers the refund's FIFO position is undefined."""
+        if self._closed is not None:
+            return
+        while self._waiters:
+            w = self._waiters.popleft()
+            if not w.is_set():
+                w.send(value)
+                return
+        self._queue.appendleft(value)
+
     def __len__(self):
         return len(self._queue)
 

@@ -35,6 +35,8 @@ from .types import COMMITTED, CONFLICT, TOO_OLD, ConflictBatchResult, TxnConflic
 class ConflictSetCPU:
     """Step-function conflict history over byte-string keys."""
 
+    max_key_bytes: int | None = None  # unlimited (the TPU twin has a width)
+
     def __init__(self, init_version: int = 0):
         # Parallel arrays, keys sorted ascending; keys[0] == b"" always.
         # versions[i] applies to [keys[i], keys[i+1]) (last segment unbounded).
@@ -120,10 +122,12 @@ class ConflictSetCPU:
                     if not w.is_empty():
                         self._set_range(w, version)
 
-        # Phase 4: GC.
-        if new_oldest_version > self.oldest_version:
-            self.oldest_version = new_oldest_version
-            self._gc()
+        # Phase 4: GC. The clamp/coalesce runs every batch (a no-op beyond
+        # the <= boundary when the horizon does not advance), keeping the
+        # step function bit-identical to the TPU kernel's, which always
+        # clamps during its merge pass.
+        self.oldest_version = max(self.oldest_version, new_oldest_version)
+        self._gc()
 
         return ConflictBatchResult(statuses)
 
@@ -146,12 +150,17 @@ class ConflictSetCPU:
         self._vers[lo:hi] = new_vers
 
     def _gc(self) -> None:
-        """Clamp versions below the horizon and coalesce equal neighbours."""
+        """Clamp versions at-or-below the horizon to 0 and coalesce equal
+        neighbours. The clamp is `<=` (not `<`): an entry at exactly
+        oldest_version can never conflict either (every live snapshot is
+        >= oldest_version >= it), and the inclusive clamp gives 0 a unique
+        meaning — "at or below the horizon" — shared bit-for-bit with the
+        TPU kernel's int32-offset representation."""
         keys, vers = self._keys, self._vers
         out_k: list[bytes] = []
         out_v: list[int] = []
         for k, v in zip(keys, vers):
-            if v < self.oldest_version:
+            if v <= self.oldest_version:
                 v = 0
             if out_v and out_v[-1] == v:
                 continue

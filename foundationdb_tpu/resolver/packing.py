@@ -161,8 +161,6 @@ class FusedLayout:
       q_end    R       sorted position of each read's end endpoint
       s_begin  Wr      sorted position of each write's begin endpoint
       s_end    Wr      sorted position of each write's end endpoint
-      is_wb    P2      1 where the sorted slot is a write-begin endpoint
-      is_we    P2      1 where the sorted slot is a write-end endpoint
       rtxn     R       owning txn of each read row
       rsnap    R       read snapshot as offset from the batch base version
       wtxn     Wr      owning txn of each write row
@@ -192,8 +190,6 @@ class FusedLayout:
         self.off_q_end = o; o += self.R
         self.off_s_begin = o; o += self.Wr
         self.off_s_end = o; o += self.Wr
-        self.off_is_wb = o; o += self.P2
-        self.off_is_we = o; o += self.P2
         self.off_rtxn = o; o += self.R
         self.off_rsnap = o; o += self.R
         self.off_wtxn = o; o += self.Wr
@@ -297,9 +293,6 @@ def pack_batch(
     smat[n_words, :] = INT32_MAX
     smat[:n_words, :P] = words[order].T
     smat[n_words, :P] = lens[order]
-    sorted_tags = tags[order]
-    buf[lay.off_is_wb : lay.off_is_wb + P] = sorted_tags == TAG_WB
-    buf[lay.off_is_we : lay.off_is_we + P] = sorted_tags == TAG_WE
 
     buf[lay.off_q_end : lay.off_q_end + R] = inv[:R]
     buf[lay.off_s_end : lay.off_s_end + Wr] = inv[R : R + Wr]

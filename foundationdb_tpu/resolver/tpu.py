@@ -126,22 +126,24 @@ def _lower_rank(hkeys, qmat):
     return pos
 
 
-def _build_max_table(v):
-    """(L, C) sparse table over versions: row m holds max over [i, i+2^m)."""
+def _build_table(v, op, identity):
+    """(L, C) sparse range-query table: row m combines windows [i, i+2^m)."""
     c = v.shape[0]
     rows = [v]
     s = 1
     while s < c:
         prev = rows[-1]
-        shifted = jnp.concatenate([prev[s:], jnp.zeros(s, dtype=v.dtype)])
-        rows.append(jnp.maximum(prev, shifted))
+        shifted = jnp.concatenate(
+            [prev[s:], jnp.full(s, identity, dtype=v.dtype)]
+        )
+        rows.append(op(prev, shifted))
         s *= 2
     return jnp.stack(rows)
 
 
-def _table_range_max(table, lo, hi):
-    """Max over [lo, hi) per query via the sparse table; empty ranges -> 0.
-    One flattened 2-row gather."""
+def _table_range_query(table, lo, hi, op, identity):
+    """op-combine over [lo, hi) per query; empty ranges -> identity. One
+    flattened 2-row gather (two overlapping power-of-two windows)."""
     c = table.shape[1]
     length = (hi - lo).astype(jnp.int32)
     m = 31 - lax.clz(jnp.maximum(length, 1))
@@ -150,31 +152,7 @@ def _table_range_max(table, lo, hi):
     i1 = m * c + jnp.clip(lo, 0, c - 1)
     i2 = m * c + jnp.clip(hi - window, 0, c - 1)
     got = flat[jnp.stack([i1, i2])]
-    return jnp.where(hi > lo, jnp.maximum(got[0], got[1]), 0)
-
-
-def _build_min_table(v):
-    c = v.shape[0]
-    rows = [v]
-    s = 1
-    while s < c:
-        prev = rows[-1]
-        shifted = jnp.concatenate([prev[s:], jnp.full(s, _I32_INF)])
-        rows.append(jnp.minimum(prev, shifted))
-        s *= 2
-    return jnp.stack(rows)
-
-
-def _table_range_min(table, lo, hi):
-    c = table.shape[1]
-    length = (hi - lo).astype(jnp.int32)
-    m = 31 - lax.clz(jnp.maximum(length, 1))
-    window = jnp.left_shift(jnp.int32(1), m)
-    flat = table.reshape(-1)
-    i1 = m * c + jnp.clip(lo, 0, c - 1)
-    i2 = m * c + jnp.clip(hi - window, 0, c - 1)
-    got = flat[jnp.stack([i1, i2])]
-    return jnp.where(hi > lo, jnp.minimum(got[0], got[1]), _I32_INF)
+    return jnp.where(hi > lo, op(got[0], got[1]), identity)
 
 
 def _canonical_nodes_flat(pos_lo, pos_hi, n_leaves: int):
@@ -216,8 +194,6 @@ def _resolve_kernel_impl(hmat, n, fused, *, lay: FusedLayout):
     q_end = sl(lay.off_q_end, R)
     s_begin = sl(lay.off_s_begin, Wr)
     s_end = sl(lay.off_s_end, Wr)
-    is_wb = sl(lay.off_is_wb, P2)
-    is_we = sl(lay.off_is_we, P2)
     rtxn = sl(lay.off_rtxn, R)
     rsnap = sl(lay.off_rsnap, R)
     wtxn = sl(lay.off_wtxn, Wr)
@@ -240,14 +216,18 @@ def _resolve_kernel_impl(hmat, n, fused, *, lay: FusedLayout):
     # ============ Phase 1: read-vs-history ============
     rank_e = lb[q_end]    # #h < read_end
     rank_b = ub[q_begin]  # #h <= read_begin  (>= 1: sentinel "" is minimal)
-    vtab = _build_max_table(hv)
-    hist_max = _table_range_max(vtab, rank_b - 1, rank_e)
+    vtab = _build_table(hv, jnp.maximum, 0)
+    hist_max = _table_range_query(vtab, rank_b - 1, rank_e, jnp.maximum, 0)
     read_conf = (hist_max > rsnap).astype(i32)
     hist_conf = jnp.zeros(T, dtype=i32).at[rtxn].max(read_conf)
     base_conf = jnp.maximum(hist_conf, too_old.astype(i32))
 
     # ============ Phase 2: intra-batch fixed point ============
     # Derived-on-device position metadata (cheaper than widening the H2D).
+    # Write-begin slots come straight from s_begin (pad rows included,
+    # matching the host tags they replace — pad intervals are empty so they
+    # never contribute elsewhere).
+    is_wb = jnp.zeros(P2, dtype=i32).at[s_begin].set(1)
     wb_excl = jnp.cumsum(is_wb) - is_wb   # #write-begins strictly before pos
     lh = wb_excl[jnp.stack([q_begin, q_end])]
     lo_r, hi_r = lh[0], lh[1]
@@ -266,7 +246,10 @@ def _resolve_kernel_impl(hmat, n, fused, *, lay: FusedLayout):
         committed_w = w_valid & (conflict[wtxn] == 0)
         wval = jnp.where(committed_w, wtxn, _I32_INF).astype(i32)
         # Case A: writes beginning strictly inside the read's span.
-        case_a = _table_range_min(_build_min_table(wval[perm_w]), lo_r, hi_r)
+        case_a = _table_range_query(
+            _build_table(wval[perm_w], jnp.minimum, _I32_INF),
+            lo_r, hi_r, jnp.minimum, _I32_INF,
+        )
         # Case B: writes covering the read's begin position.
         wval_rep = jnp.broadcast_to(wval, (n_blocks, Wr)).reshape(-1)
         tree_l = jnp.full(2 * P2, _I32_INF, dtype=i32).at[wnodes].min(wval_rep)
@@ -357,8 +340,11 @@ def _resolve_kernel_impl(hmat, n, fused, *, lay: FusedLayout):
     covered = at_end[1] > at_end[2]
     old_val = hv[jnp.clip(at_end[0] - 1, 0, C - 1)]
     val = jnp.where(covered, version, old_val)
-    # Stale clamp + rebase to the new base (= absolute oldest_eff).
-    val = jnp.where(val < oldest_eff, 0, val - oldest_eff)
+    # Stale clamp + rebase to the new base (= absolute oldest_eff). The
+    # clamp is inclusive so offset 0 uniquely means "at or below the
+    # horizon" — same convention as ConflictSetCPU._gc, so entries() of the
+    # two implementations stay bit-identical.
+    val = jnp.where(val <= oldest_eff, 0, val - oldest_eff)
 
     # Valid points: real history entries + committed write endpoints.
     valid_pt = (is_h_m | cwb_m | cwe_m).astype(i32)
@@ -635,7 +621,8 @@ class ConflictSetTPU:
         if shapes is None:
             shapes = [(b, 5 * b, 2 * b) for b in SERVER_KNOBS.TPU_BATCH_BUCKETS]
         saved = (self.hmat, self.n, self._n_known, self._cum_writes,
-                 self._result_cum, self.oldest_version)
+                 self._result_cum, self._dispatch_seq, self._result_seq,
+                 self.oldest_version)
         for (t, r, w) in shapes:
             batch = pack_batch(
                 [], self.oldest_version, self.n_words,
@@ -643,4 +630,5 @@ class ConflictSetTPU:
             )
             self.resolve_packed(self.oldest_version, 0, batch)
             (self.hmat, self.n, self._n_known, self._cum_writes,
-             self._result_cum, self.oldest_version) = saved
+             self._result_cum, self._dispatch_seq, self._result_seq,
+             self.oldest_version) = saved

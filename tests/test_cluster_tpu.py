@@ -1,0 +1,31 @@
+"""The BASELINE north-star integration: the full transaction system with
+conflict detection on the ConflictSetTPU kernel behind the same resolver
+interface, fed by the proxy's commit batcher — differentially checked by
+the Cycle invariant (and implicitly against the CPU path, which the rest of
+the suite runs with the same seeds)."""
+
+from foundationdb_tpu.cluster import LocalCluster
+from foundationdb_tpu.core.runtime import loop_context, sim_loop
+from foundationdb_tpu.resolver.tpu import ConflictSetTPU
+from foundationdb_tpu.workloads.cycle import CycleWorkload
+
+
+def test_cycle_on_tpu_resolver():
+    loop = sim_loop(seed=11)
+    with loop_context(loop):
+        cs = ConflictSetTPU(max_key_bytes=16, initial_capacity=64)
+        cluster = LocalCluster(conflict_set=cs).start()
+        db = cluster.database()
+
+        async def main():
+            wl = CycleWorkload(db, nodes=10)
+            await wl.setup()
+            await wl.start(clients=3, txns_per_client=8)
+            ok = await wl.check()
+            cluster.stop()
+            return ok, wl.retries
+
+        ok, retries = loop.run(main(), timeout_sim_seconds=1e6)
+    assert ok
+    assert retries > 0  # the kernel detected real conflicts
+    assert cluster.resolver.conflict_transactions > 0
