@@ -1,0 +1,97 @@
+"""ClusterConnection: the client's view of the cluster's endpoints.
+
+Bundles the three endpoints a client needs — GRV, commit, storage reads —
+behind retry/timeout semantics faithful to the reference:
+
+- Reads and GRVs are idempotent: on timeout they retry forever with
+  backoff (the reference's loadBalance + failure monitoring keep retrying
+  replicas, fdbrpc/LoadBalance.actor.h:164).
+- Commits are NOT idempotent: a commit whose reply is lost surfaces as
+  CommitUnknownResult (retryable at transaction level, with the documented
+  maybe-committed ambiguity — fdbclient/NativeAPI.actor.cpp tryCommit's
+  broken_promise/request_maybe_delivered handling).
+
+Endpoints are anything with .send(req): the in-process PromiseStream
+directly (LocalCluster) or a sim.RemoteStream routing through the
+simulated network — same client code either way.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..core.actors import timeout
+from ..core.errors import CommitUnknownResult
+from ..core.knobs import CLIENT_KNOBS
+from ..core.runtime import current_loop
+from ..cluster.interfaces import (
+    CommitTransactionRequest,
+    GetRangeRequest,
+    GetReadVersionRequest,
+    GetValueRequest,
+    WatchValueRequest,
+)
+
+_LOST = object()
+
+
+class ClusterConnection:
+    def __init__(self, grv_endpoint, commit_endpoint, storage_endpoint,
+                 resolver_key_width: Optional[int] = None):
+        self.grv_endpoint = grv_endpoint
+        self.commit_endpoint = commit_endpoint
+        self.storage_endpoint = storage_endpoint
+        self.resolver_key_width = resolver_key_width
+
+    async def _retrying(self, make_req, endpoint, request_timeout: float):
+        """Idempotent request: re-send (a fresh request) on timeout,
+        backing off, forever — progress resumes when the network heals."""
+        loop = current_loop()
+        backoff = CLIENT_KNOBS.DEFAULT_BACKOFF
+        while True:
+            req = make_req()
+            endpoint.send(req)
+            result = await timeout(req.reply.future, request_timeout, _LOST)
+            if result is not _LOST:
+                return result
+            await loop.delay(backoff * (0.5 + loop.random.random01()))
+            backoff = min(
+                backoff * CLIENT_KNOBS.BACKOFF_GROWTH_RATE,
+                CLIENT_KNOBS.DEFAULT_MAX_BACKOFF,
+            )
+
+    async def get_read_version(self) -> int:
+        return await self._retrying(
+            GetReadVersionRequest, self.grv_endpoint,
+            CLIENT_KNOBS.GRV_TIMEOUT,
+        )
+
+    async def get_value(self, key: bytes, version: int):
+        return await self._retrying(
+            lambda: GetValueRequest(key, version), self.storage_endpoint,
+            CLIENT_KNOBS.READ_TIMEOUT,
+        )
+
+    async def get_range(self, begin, end, version, limit=0, reverse=False):
+        return await self._retrying(
+            lambda: GetRangeRequest(begin, end, version, limit, reverse),
+            self.storage_endpoint, CLIENT_KNOBS.READ_TIMEOUT,
+        )
+
+    def watch(self, req: WatchValueRequest):
+        """Watches are long-lived: no client-side timeout; a lost watch
+        surfaces when the owning caller re-reads (the reference's watches
+        are similarly best-effort with client re-registration)."""
+        self.storage_endpoint.send(req)
+        return req.reply.future
+
+    async def commit(self, req: CommitTransactionRequest):
+        self.commit_endpoint.send(req)
+        result = await timeout(
+            req.reply.future, CLIENT_KNOBS.COMMIT_TIMEOUT, _LOST
+        )
+        if result is _LOST:
+            # The batch may or may not have committed — the defining OCC
+            # client ambiguity (ref: commit_unknown_result).
+            raise CommitUnknownResult()
+        return result

@@ -15,8 +15,20 @@ T = TypeVar("T")
 
 
 class Database:
-    def __init__(self, cluster):
+    def __init__(self, cluster, conn=None):
         self.cluster = cluster
+        if conn is None:
+            from .connection import ClusterConnection
+
+            conn = ClusterConnection(
+                cluster.proxy.grv_stream,
+                cluster.proxy.commit_stream,
+                cluster.storage.read_stream,
+                resolver_key_width=getattr(
+                    cluster.resolver.cs, "max_key_bytes", None
+                ),
+            )
+        self.conn = conn
 
     def create_transaction(self) -> Transaction:
         return Transaction(self)

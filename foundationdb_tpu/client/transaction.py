@@ -37,9 +37,6 @@ from ..kv.atomic import MutationType, apply_atomic
 from ..kv.keys import KeyRange, key_after
 from ..cluster.interfaces import (
     CommitTransactionRequest,
-    GetRangeRequest,
-    GetReadVersionRequest,
-    GetValueRequest,
     Mutation,
     WatchValueRequest,
 )
@@ -84,6 +81,11 @@ class Transaction:
         self._reset()
 
     def _reset(self):
+        # Watches from an abandoned attempt must not hang their waiters:
+        # resolve them with cancellation (the reference cancels watch
+        # futures when the transaction resets).
+        for w in getattr(self, "_watch_list", []):
+            w._fail(TransactionCancelled())
         self._read_version_f: Optional[Future] = None
         self._writes: dict[bytes, _WriteEntry] = {}
         self._clears: list[KeyRange] = []
@@ -102,9 +104,8 @@ class Transaction:
         """GRV; batched proxy-side (ref: readVersionBatcher :2700)."""
         self._check_usable()
         if self._read_version_f is None:
-            req = GetReadVersionRequest()
-            self._db.cluster.proxy.grv_stream.send(req)
-            self._read_version_f = req.reply.future
+            task = spawn(self._db.conn.get_read_version(), name="grv")
+            self._read_version_f = task.done
         return self._read_version_f
 
     def set_read_version(self, version: int) -> None:
@@ -119,15 +120,20 @@ class Transaction:
         if self._commit_outstanding:
             raise UsedDuringCommit()
 
-    def _check_key(self, key: bytes):
+    def _check_key(self, key: bytes, is_end: bool = False):
+        """Admission (ref: key_too_large, fdbclient/NativeAPI.actor.cpp
+        Transaction::set). Point keys must leave room for their conflict
+        range's key_after() end, so against a limit L a point key may be at
+        most L-? — concretely: end keys get a +1 allowance over point keys
+        (the reference likewise accepts keyAfter(max-size key) as a range
+        end), and when the deployment's resolver packs keys at a fixed
+        width W, point keys are capped at W-1 so key_after still fits."""
         limit = CLIENT_KNOBS.KEY_SIZE_LIMIT
-        # The deployment's resolver may pack keys at a narrower fixed width
-        # (ConflictSetTPU.max_key_bytes); admission happens here, client
-        # side, exactly where the reference rejects key_too_large
-        # (fdbclient/NativeAPI.actor.cpp Transaction::set).
-        width = getattr(self._db.cluster.resolver.cs, "max_key_bytes", None)
+        width = self._db.conn.resolver_key_width
         if width is not None:
-            limit = min(limit, width)
+            limit = min(limit, width - 1)
+        if is_end:
+            limit += 1
         if len(key) > limit:
             raise KeyTooLarge(f"key of {len(key)} bytes exceeds limit {limit}")
 
@@ -144,14 +150,11 @@ class Transaction:
         if not snapshot:
             self._read_conflicts.append(KeyRange(key, key_after(key)))
         if entry is None:
-            req = GetValueRequest(key, version)
-            return await self._db.cluster.storage.get_value(req)
+            return await self._db.conn.get_value(key, version)
         # Atomic stack over an unread base: fetch base and fold.
         base = None
         if not entry.cleared_base and not self._covered_by_clear(key):
-            base = await self._db.cluster.storage.get_value(
-                GetValueRequest(key, version)
-            )
+            base = await self._db.conn.get_value(key, version)
         return entry.resolve(base)
 
     async def get_range(
@@ -173,13 +176,13 @@ class Transaction:
             # Fast path: no local writes in range — the storage scan can be
             # clipped to the caller's limit/direction directly (the
             # reference clips server-side the same way).
-            req = GetRangeRequest(begin, end, version, limit, reverse)
-            rows = await self._db.cluster.storage.get_range(req)
+            rows = await self._db.conn.get_range(
+                begin, end, version, limit, reverse
+            )
         else:
             # RYW merge: an uncommitted overlay can hide or add rows, so
             # the limit can only be applied after merging; scan unclipped.
-            req = GetRangeRequest(begin, end, version, limit=0, reverse=False)
-            stored = await self._db.cluster.storage.get_range(req)
+            stored = await self._db.conn.get_range(begin, end, version)
             merged: dict[bytes, Optional[bytes]] = {}
             for k, v in stored:
                 if not self._covered_by_clear(k):
@@ -240,7 +243,7 @@ class Transaction:
     def clear_range(self, begin: bytes, end: bytes) -> None:
         self._check_usable()
         self._check_key(begin)
-        self._check_key(end)
+        self._check_key(end, is_end=True)
         if begin > end:
             raise InvertedRange()
         if begin == end:
@@ -317,8 +320,7 @@ class Transaction:
         )
         self._commit_outstanding = True
         try:
-            self._db.cluster.proxy.commit_stream.send(req)
-            commit_id = await req.reply.future
+            commit_id = await self._db.conn.commit(req)
         finally:
             self._commit_outstanding = False
         self._committed_version = commit_id.version
@@ -326,9 +328,16 @@ class Transaction:
         return commit_id.version
 
     async def _arm_watches(self, version: int) -> None:
+        """Best-effort: arming failures resolve the watch handle with the
+        error rather than raising — by this point the commit is durable, so
+        commit() must report success regardless (a raise here would make
+        the caller's retry loop double-apply a committed transaction)."""
         for w in self._watch_list:
-            value = await self.get(w.key, snapshot=True)
-            w._arm(version, value)
+            try:
+                value = await self.get(w.key, snapshot=True)
+                w._arm(version, value)
+            except BaseException as e:  # noqa: BLE001
+                w._fail(e)
         self._watch_list = []
 
     async def on_error(self, err: BaseException) -> None:
@@ -369,14 +378,15 @@ class _PendingWatch:
 
     def _arm(self, version: int, value: Optional[bytes]) -> None:
         req = WatchValueRequest(self.key, value, version)
+        self._ready.send(self._db.conn.watch(req))
 
-        async def run():
-            return await self._db.cluster.storage.watch_value(req)
-
-        task = spawn(run(), name=f"watch:{self.key!r}")
-        self._ready.send(task.done)
+    def _fail(self, err: BaseException) -> None:
+        if not self._ready.is_set():
+            self._ready.send_error(err)
 
     async def wait(self) -> int:
-        """Resolves with the version at which the value changed."""
+        """Resolves with the version at which the value changed; raises
+        TransactionCancelled if the owning attempt was reset before
+        commit, or the arming error if registration failed."""
         inner = await self._ready.future
         return await inner

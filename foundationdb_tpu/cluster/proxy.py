@@ -104,25 +104,35 @@ class CommitProxy:
 
     # -- commit pipeline --
     async def _commit_batch(self, reqs: list[CommitTransactionRequest]):
+        # Phase 1: version window (master is the version authority). Taken
+        # OUTSIDE the try so the failure path can still drive this window
+        # through the tlog chain.
+        prev_version, version = self.master.get_commit_version()
         try:
-            await self._commit_batch_impl(reqs)
+            await self._commit_batch_impl(reqs, prev_version, version)
         except BaseException as e:
             # A wedged batch must never strand its clients or the batches
-            # behind it: answer everyone still waiting with a non-retryable
-            # error (nothing in this batch was reported committed, and the
-            # resolver advanced its version on failure, so the pipeline
-            # stays live and sound — conservative all-abort semantics).
+            # behind it. Nothing in this batch was reported committed, so
+            # conservative all-abort semantics stay sound — but BOTH
+            # version chains must still advance: the resolver's (done in
+            # resolve_batch's own failure path) and the tlog's, via an
+            # empty batch for this window (tlog.commit is idempotent per
+            # window, so a failure after logging is safe too).
             from ..core.errors import OperationFailed
 
             TraceEvent("ProxyCommitBatchError", severity=40).error(e).log()
+            await self.resolver.skip_window(prev_version, version)
+            await self.tlog.commit(prev_version, version, [])
+            self.master.report_committed(version)
             for r in reqs:
                 if not r.reply.is_set():
                     r.reply.send_error(OperationFailed(str(e)))
 
-    async def _commit_batch_impl(self, reqs: list[CommitTransactionRequest]):
+    async def _commit_batch_impl(
+        self, reqs: list[CommitTransactionRequest], prev_version: int,
+        version: int,
+    ):
         loop = current_loop()
-        # Phase 1: version window (master is the version authority).
-        prev_version, version = self.master.get_commit_version()
         TraceEvent("ProxyCommitBatch").detail("Version", version).detail(
             "Txns", len(reqs)
         ).log()

@@ -20,6 +20,14 @@ from .interfaces import ResolveTransactionBatchRequest
 
 
 class ResolverRole:
+    async def skip_window(self, prev_version: int, version: int) -> None:
+        """Advance the version chain over a window that resolved nothing
+        (a proxy batch that failed before reaching this resolver). No-op
+        if the window was already resolved — idempotent by construction."""
+        await self.version.when_at_least(prev_version)
+        if self.version.get() == prev_version:
+            self.version.set(version)
+
     def __init__(self, conflict_set, init_version: int = 0):
         self.cs = conflict_set
         self.version = NotifiedVersion(init_version)

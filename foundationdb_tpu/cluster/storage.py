@@ -30,16 +30,48 @@ class StorageServer:
         self.version = NotifiedVersion(init_version)  # applied through here
         self.oldest_version = init_version
         self._watches: list[WatchValueRequest] = []
-        self._update_task = None
+        # Read endpoint (ref: StorageServerInterface.h:31 — getValue,
+        # getKeyValues, watchValue request streams served by one role).
+        self.read_stream: PromiseStream = PromiseStream()
+        self._tasks = []
 
     def start(self) -> None:
-        self._update_task = spawn(
-            self._update_loop(), TaskPriority.STORAGE, name="storage_update"
-        )
+        self._tasks = [
+            spawn(self._update_loop(), TaskPriority.STORAGE,
+                  name="storage_update"),
+            spawn(self._serve_loop(), TaskPriority.STORAGE,
+                  name="storage_serve"),
+        ]
 
     def stop(self) -> None:
-        if self._update_task is not None:
-            self._update_task.cancel()
+        for t in self._tasks:
+            t.cancel()
+
+    # -- request serving: each request answered via its reply promise so the
+    #    endpoint works identically in-process and across the sim network --
+    async def _serve_loop(self):
+        while True:
+            req = await self.read_stream.pop()
+            spawn(self._serve_one(req), TaskPriority.STORAGE,
+                  name="storage_req")
+
+    async def _serve_one(self, req):
+        try:
+            if isinstance(req, GetValueRequest):
+                result = await self.get_value(req)
+            elif isinstance(req, GetRangeRequest):
+                result = await self.get_range(req)
+            elif isinstance(req, WatchValueRequest):
+                # watch_value resolves req.reply itself on change.
+                await self.watch_value(req)
+                return
+            else:
+                raise TypeError(f"unknown storage request {type(req)}")
+            if not req.reply.is_set():
+                req.reply.send(result)
+        except BaseException as e:  # noqa: BLE001 — errors go to the caller
+            if not req.reply.is_set():
+                req.reply.send_error(e)
 
     # -- ingest (ref: update :2321) --
     async def _update_loop(self):
@@ -95,10 +127,16 @@ class StorageServer:
     # -- reads (ref: getValueQ :680) --
     async def _wait_for_version(self, version: int) -> None:
         """(ref: waitForVersion :627). Blocks until the node catches up; a
-        read below the window raises TransactionTooOld (:634)."""
+        read below the window raises TransactionTooOld (:634). The window
+        check repeats AFTER the wait: the update loop can apply a large
+        version jump and trim the window past `version` while this request
+        was parked, and the VersionedMap's window assertion must never be
+        reachable from a client request."""
         if version < self.oldest_version:
             raise TransactionTooOld()
         await self.version.when_at_least(version)
+        if version < self.oldest_version:
+            raise TransactionTooOld()
 
     async def get_value(self, req: GetValueRequest) -> Optional[bytes]:
         await self._wait_for_version(req.version)
@@ -111,12 +149,14 @@ class StorageServer:
         )
 
     async def watch_value(self, req: WatchValueRequest) -> int:
-        """Resolves with the version at which the value was seen to differ
-        (ref: watchValue_impl :758)."""
+        """Resolves req.reply (and returns) the version at which the value
+        was seen to differ (ref: watchValue_impl :758)."""
         await self._wait_for_version(req.version)
         cur = self.data.get(req.key, self.version.get())
         if cur != req.value:
-            return self.version.get()
-        self._watches.append(req)
-        TraceEvent("StorageWatchStarted").detail("Key", req.key).log()
+            if not req.reply.is_set():
+                req.reply.send(self.version.get())
+        else:
+            self._watches.append(req)
+            TraceEvent("StorageWatchStarted").detail("Key", req.key).log()
         return await req.reply.future

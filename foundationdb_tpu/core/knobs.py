@@ -100,6 +100,11 @@ class ClientKnobs(Knobs):
         init("MAX_BATCH_SIZE", 1000)
         init("GRV_BATCH_INTERVAL", 0.001)
         init("DEFAULT_BACKOFF", 0.01)
+        # Client-side RPC deadlines (reads/GRVs re-send after these; a lost
+        # commit reply becomes commit_unknown_result).
+        init("READ_TIMEOUT", 5.0)
+        init("GRV_TIMEOUT", 5.0)
+        init("COMMIT_TIMEOUT", 20.0)
         init("DEFAULT_MAX_BACKOFF", 1.0)
         init("BACKOFF_GROWTH_RATE", 2.0)
 

@@ -1,0 +1,7 @@
+"""Deterministic simulation beyond the virtual clock: simulated processes,
+a lossy/laggy in-memory network with clogs and partitions, and the fault
+arsenal that drives workload tests (ref: fdbrpc/sim2.actor.cpp +
+fdbrpc/simulator.h; SURVEY §4 tier 2 — "the backbone")."""
+
+from .network import RemoteStream, SimNetwork, SimProcess  # noqa: F401
+from .harness import SimulatedCluster  # noqa: F401

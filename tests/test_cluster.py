@@ -207,3 +207,75 @@ def test_cycle_workload_deterministic():
     assert a1 == a2, "same seed must replay identically"
     assert a1[0][0] and b[0][0]
     assert a1 != b, "different seed should explore a different interleaving"
+
+
+def test_key_width_admission_and_pipeline_survival():
+    """A key at the resolver's packed width is rejected client-side (its
+    conflict-range end wouldn't fit), and even an internal resolver error
+    (malformed request injected past the client checks) fails only its own
+    batch — the pipeline keeps committing afterwards."""
+    from foundationdb_tpu.cluster.interfaces import (
+        CommitTransactionRequest, Mutation,
+    )
+    from foundationdb_tpu.core.errors import KeyTooLarge, OperationFailed
+    from foundationdb_tpu.kv.atomic import MutationType
+    from foundationdb_tpu.resolver.tpu import ConflictSetTPU
+
+    loop = sim_loop(seed=3)
+    with loop_context(loop):
+        cs = ConflictSetTPU(max_key_bytes=16, initial_capacity=64)
+        cluster = LocalCluster(conflict_set=cs).start()
+        db = cluster.database()
+
+        async def main():
+            tr = db.create_transaction()
+            with pytest.raises(KeyTooLarge):
+                tr.set(b"x" * 16, b"v")  # width 16: point keys max 15
+            tr.set(b"x" * 15, b"v")  # fits, key_after end is 16 bytes
+            await tr.commit()
+
+            # Malformed request straight into the proxy: oversized write
+            # range end blows up inside resolution; the batch fails...
+            bad = CommitTransactionRequest(
+                read_snapshot=0, read_conflict_ranges=(),
+                write_conflict_ranges=(),
+                mutations=(Mutation(MutationType.SET_VALUE, b"y" * 40, b"v"),),
+            )
+            cluster.proxy.commit_stream.send(bad)
+            with pytest.raises(OperationFailed):
+                await bad.reply.future
+            # ...but the pipeline is still alive and sound.
+            await db.set(b"alive", b"yes")
+            assert await db.get(b"alive") == b"yes"
+            assert await db.get(b"x" * 15) == b"v"
+            cluster.stop()
+
+        loop.run(main(), timeout_sim_seconds=1e6)
+
+
+def test_clear_of_max_size_key():
+    from foundationdb_tpu.core.knobs import CLIENT_KNOBS
+
+    async def main(db):
+        big = b"k" * CLIENT_KNOBS.KEY_SIZE_LIMIT
+        await db.set(big, b"v")
+        assert await db.get(big) == b"v"
+        await db.clear(big)  # end key gets the keyAfter +1 allowance
+        assert await db.get(big) is None
+
+    run_sim(main)
+
+
+def test_reset_cancels_pending_watches():
+    from foundationdb_tpu.core.errors import TransactionCancelled
+
+    async def main(db):
+        await db.set(b"w", b"a")
+        tr = db.create_transaction()
+        assert await tr.get(b"w") == b"a"
+        watch = tr.watch(b"w")
+        tr.reset()  # abandoned attempt: the watch must fail, not hang
+        with pytest.raises(TransactionCancelled):
+            await watch.wait()
+
+    run_sim(main)

@@ -1,0 +1,103 @@
+"""Cycle under network faults: clogs, partitions, blackouts — the
+simulation-backbone test tier (SURVEY §4 tier 2). The invariant must hold
+across seeds WITH faults + buggify enabled, and identical seeds must
+replay identical traces."""
+
+import hashlib
+import json
+
+import pytest
+
+from foundationdb_tpu.core.runtime import loop_context, sim_loop
+from foundationdb_tpu.core.trace import TraceSink, set_global_sink
+from foundationdb_tpu.sim import SimulatedCluster
+from foundationdb_tpu.workloads.cycle import CycleWorkload
+
+
+def run_cycle_with_faults(seed: int, *, clogging=True, attrition=True,
+                          nodes=10, clients=4, txns=12):
+    sink = TraceSink()
+    set_global_sink(sink)
+    loop = sim_loop(seed=seed, buggify=True)
+    with loop_context(loop):
+        sc = SimulatedCluster()
+        db = sc.database()
+
+        async def main():
+            wl = CycleWorkload(db, nodes=nodes)
+            await wl.setup()
+            # Fault cadence matched to the workload's virtual duration
+            # (tens of ms per txn): several clogs + at least one blackout
+            # land inside the run.
+            if clogging:
+                sc.start_random_clogging(mean_interval=0.05, max_clog=0.2)
+            if attrition:
+                sc.start_attrition(mean_interval=0.8, max_outage=0.5)
+            await wl.start(clients=clients, txns_per_client=txns)
+            ok = await wl.check()
+            sc.stop()
+            return ok, wl.txns_done, wl.retries
+
+        ok, done, retries = loop.run(main(), timeout_sim_seconds=1e6)
+    digest = hashlib.sha256(
+        "\n".join(
+            json.dumps(e, sort_keys=True, default=str) for e in sink.events
+        ).encode()
+    ).hexdigest()
+    return ok, done, retries, sink, digest, sc
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3, 4, 5])
+def test_cycle_survives_network_faults(seed):
+    ok, done, retries, sink, _, sc = run_cycle_with_faults(seed)
+    assert ok, f"cycle invariant broken under faults (seed {seed})"
+    assert done == 48
+    # The faults actually fired.
+    assert sink.count("SimClogPair") + sink.count("SimBlackout") > 0
+    assert not sink.has_severity(40)
+
+
+def test_fault_run_is_deterministic():
+    a = run_cycle_with_faults(99)
+    b = run_cycle_with_faults(99)
+    assert a[4] == b[4], "same seed+faults must replay bit-identically"
+    c = run_cycle_with_faults(100)
+    assert a[4] != c[4]
+
+
+def test_blackout_drops_messages_and_recovery_resumes():
+    ok, done, retries, sink, _, sc = run_cycle_with_faults(
+        7, clogging=False, attrition=True, clients=3, txns=10
+    )
+    assert ok
+    assert sc.net.messages_dropped > 0, "blackouts should eat messages"
+    # Lost replies surface as retries (commit_unknown_result / timeouts).
+    assert retries > 0
+
+
+def test_partition_heals():
+    from foundationdb_tpu.core.runtime import current_loop, spawn
+
+    loop = sim_loop(seed=5)
+    with loop_context(loop):
+        sc = SimulatedCluster()
+        db = sc.database()
+
+        async def main():
+            await db.set(b"k", b"1")
+            sc.net.partition(sc.client_proc, sc.server)
+
+            async def heal_later():
+                await current_loop().delay(3.0)
+                sc.net.heal(sc.client_proc, sc.server)
+
+            spawn(heal_later(), name="healer")
+            # Read keeps retrying through the partition and completes
+            # after the heal.
+            t0 = current_loop().now()
+            v = await db.get(b"k")
+            assert v == b"1"
+            assert current_loop().now() >= 3.0 - 1e-9
+            sc.stop()
+
+        loop.run(main(), timeout_sim_seconds=1e6)
