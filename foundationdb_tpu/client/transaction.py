@@ -86,6 +86,12 @@ class Transaction:
         # futures when the transaction resets).
         for w in getattr(self, "_watch_list", []):
             w._fail(TransactionCancelled())
+        # The GRV task retries forever by design (idempotent request); an
+        # abandoned attempt must take its retry loop down with it.
+        t = getattr(self, "_grv_task", None)
+        if t is not None and not t.done.is_ready():
+            t.cancel()
+        self._grv_task = None
         self._read_version_f: Optional[Future] = None
         self._writes: dict[bytes, _WriteEntry] = {}
         self._clears: list[KeyRange] = []
@@ -104,8 +110,8 @@ class Transaction:
         """GRV; batched proxy-side (ref: readVersionBatcher :2700)."""
         self._check_usable()
         if self._read_version_f is None:
-            task = spawn(self._db.conn.get_read_version(), name="grv")
-            self._read_version_f = task.done
+            self._grv_task = spawn(self._db.conn.get_read_version(), name="grv")
+            self._read_version_f = self._grv_task.done
         return self._read_version_f
 
     def set_read_version(self, version: int) -> None:
@@ -166,6 +172,8 @@ class Transaction:
         snapshot: bool = False,
     ) -> list[tuple[bytes, bytes]]:
         self._check_usable()
+        self._check_key(begin)
+        self._check_key(end, is_end=True)
         if begin > end:
             raise InvertedRange()
         version = await self.get_read_version()
@@ -271,12 +279,16 @@ class Transaction:
 
     # -- conflict ranges (ref: tr.add_read/write_conflict_range) --
     def add_read_conflict_range(self, begin: bytes, end: bytes) -> None:
+        self._check_key(begin)
+        self._check_key(end, is_end=True)
         self._read_conflicts.append(KeyRange(begin, end))
 
     def add_read_conflict_key(self, key: bytes) -> None:
         self.add_read_conflict_range(key, key_after(key))
 
     def add_write_conflict_range(self, begin: bytes, end: bytes) -> None:
+        self._check_key(begin)
+        self._check_key(end, is_end=True)
         self._extra_write_conflicts.append(KeyRange(begin, end))
 
     def add_write_conflict_key(self, key: bytes) -> None:
@@ -371,7 +383,6 @@ class _PendingWatch:
     def __init__(self, db, key: bytes):
         self._db = db
         self.key = key
-        self._future: Optional[Future] = None
         from ..core.runtime import Promise
 
         self._ready = Promise()

@@ -22,7 +22,7 @@ from .network import RemoteStream, SimNetwork, SimProcess
 
 
 class SimulatedCluster:
-    def __init__(self, conflict_set=None, seed_faults: bool = True):
+    def __init__(self, conflict_set=None):
         self.net = SimNetwork()
         self.server = SimProcess("server")
         self.client_proc = SimProcess("client")

@@ -19,7 +19,7 @@ recovery tier's subject (SURVEY §7 step 5), not the network's.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, replace
+from dataclasses import replace
 from typing import Callable
 
 from ..core.runtime import TaskPriority, current_loop, spawn

@@ -1,0 +1,12 @@
+"""Durable local storage tier (ref: fdbserver/IKeyValueStore.h engines).
+
+- DiskQueue: page-checksummed two-file durable FIFO (native C++ fsync path
+  in native/diskqueue.cpp, ctypes-bound, with a format-identical pure-
+  Python fallback) — ref fdbserver/DiskQueue.actor.cpp.
+- KeyValueStoreMemory: ordered in-memory map made durable as an operation
+  log + periodic snapshot on the DiskQueue, fully recoverable after a
+  crash — ref fdbserver/KeyValueStoreMemory.actor.cpp:258-375.
+"""
+
+from .diskqueue import DiskQueue  # noqa: F401
+from .memory_engine import KeyValueStoreMemory  # noqa: F401
