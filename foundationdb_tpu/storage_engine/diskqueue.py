@@ -33,6 +33,18 @@ _LIB_PATH = os.path.join(
 
 def _load_native():
     if not os.path.exists(_LIB_PATH):
+        # Build on demand (one g++ invocation); fall back to the Python
+        # backend on any failure (no compiler, read-only checkout, ...).
+        import subprocess
+
+        try:
+            subprocess.run(
+                ["make", "-C", os.path.dirname(_LIB_PATH)],
+                capture_output=True, timeout=120, check=True,
+            )
+        except Exception:
+            return None
+    if not os.path.exists(_LIB_PATH):
         return None
     try:
         lib = ctypes.CDLL(_LIB_PATH)
