@@ -36,12 +36,10 @@ _LOST = object()
 
 
 class ClusterConnection:
-    def __init__(self, grv_endpoint, commit_endpoint, storage_endpoint,
-                 resolver_key_width: Optional[int] = None):
+    def __init__(self, grv_endpoint, commit_endpoint, storage_endpoint):
         self.grv_endpoint = grv_endpoint
         self.commit_endpoint = commit_endpoint
         self.storage_endpoint = storage_endpoint
-        self.resolver_key_width = resolver_key_width
 
     async def _retrying(self, make_req, endpoint, request_timeout: float):
         """Idempotent request: re-send (a fresh request) on timeout,

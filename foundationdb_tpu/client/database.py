@@ -24,9 +24,6 @@ class Database:
                 cluster.proxy.grv_stream,
                 cluster.proxy.commit_stream,
                 cluster.storage.read_stream,
-                resolver_key_width=getattr(
-                    cluster.resolver.cs, "max_key_bytes", None
-                ),
             )
         self.conn = conn
 

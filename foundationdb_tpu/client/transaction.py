@@ -128,16 +128,13 @@ class Transaction:
 
     def _check_key(self, key: bytes, is_end: bool = False):
         """Admission (ref: key_too_large, fdbclient/NativeAPI.actor.cpp
-        Transaction::set). Point keys must leave room for their conflict
-        range's key_after() end, so against a limit L a point key may be at
-        most L-? — concretely: end keys get a +1 allowance over point keys
-        (the reference likewise accepts keyAfter(max-size key) as a range
-        end), and when the deployment's resolver packs keys at a fixed
-        width W, point keys are capped at W-1 so key_after still fits."""
+        Transaction::set). End keys get a +1 allowance over point keys so
+        keyAfter(max-size key) remains a legal range end, exactly like the
+        reference. No resolver-width check is needed: the conflict set
+        re-packs itself at a wider word width when longer keys arrive
+        (ConflictSetTPU._grow_width), so KEY_SIZE_LIMIT is the only
+        contract."""
         limit = CLIENT_KNOBS.KEY_SIZE_LIMIT
-        width = self._db.conn.resolver_key_width
-        if width is not None:
-            limit = min(limit, width - 1)
         if is_end:
             limit += 1
         if len(key) > limit:

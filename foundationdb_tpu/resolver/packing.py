@@ -99,6 +99,30 @@ def state_pad_block(n_words: int, columns: int) -> np.ndarray:
     return block
 
 
+def widen_state(hmat: np.ndarray, old_words: int, new_words: int) -> np.ndarray:
+    """Re-pack a (old_words+2, C) state matrix at a wider key width WITHOUT
+    decoding keys: a packed key is zero-padded to the width, so the extra
+    word rows are bias(0x00000000) for live columns and PAD_WORD for pad
+    columns (identified by the length row). Pure vectorized numpy — safe on
+    the commit path even at device-scale history sizes."""
+    assert new_words > old_words
+    C = hmat.shape[1]
+    live = hmat[old_words] != INT32_MAX
+    extra = np.where(
+        live[None, :],
+        np.int32(np.uint32(BIAS).view(np.int32)),  # biased zero word
+        PAD_WORD,
+    )
+    return np.concatenate(
+        [
+            hmat[:old_words],
+            np.broadcast_to(extra, (new_words - old_words, C)),
+            hmat[old_words:],
+        ],
+        axis=0,
+    )
+
+
 def empty_state(n_words: int, capacity: int, init_version: int) -> np.ndarray:
     """Fresh (n_words+2, capacity) state: all pad except the empty-key
     sentinel at column 0 holding init_version (the reference's skip-list

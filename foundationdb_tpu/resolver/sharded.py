@@ -34,7 +34,7 @@ import numpy as np
 
 from ..kv.keys import KeyRange
 from .cpu import ConflictSetCPU
-from .packing import flatten_batch, next_pow2, pack_batch
+from .packing import KeyWidthError, flatten_batch, next_pow2, pack_batch
 from .types import ConflictBatchResult, TxnConflictInfo
 
 
@@ -194,6 +194,31 @@ class ShardedConflictSetTPU:
         )
         return jax.jit(step)
 
+    def _grow_width(self, min_key_bytes: int) -> None:
+        """Per-shard analogue of ConflictSetTPU._grow_width: widen every
+        shard's packed state (vectorized row insertion), capped by the
+        deployment key-size knob."""
+        from ..core.knobs import CLIENT_KNOBS
+        from .packing import KeyWidthError, widen_state
+
+        cap = CLIENT_KNOBS.KEY_SIZE_LIMIT + 1
+        if min_key_bytes > cap:
+            raise KeyWidthError(
+                f"key of {min_key_bytes} bytes exceeds the deployment "
+                f"key-size limit {cap}"
+            )
+        new_words = min(
+            next_pow2((min_key_bytes + 3) // 4, minimum=self.n_words * 2),
+            next_pow2((cap + 3) // 4),
+        )
+        hmat = np.asarray(self.hmat)
+        widened = np.stack(
+            [widen_state(h, self.n_words, new_words) for h in hmat]
+        )
+        self.n_words = new_words
+        self.max_key_bytes = 4 * new_words
+        self._shard_state(widened, np.asarray(self.n))
+
     def _grow(self, min_capacity: int) -> None:
         from .packing import state_pad_block
 
@@ -235,10 +260,20 @@ class ShardedConflictSetTPU:
         caps = (max(counts_r), max(counts_w), len(txns))
         max_writes = max(counts_w)
 
-        packed = [
-            pack_batch(local, self.oldest_version, self.n_words, caps)
-            for local in per_shard
-        ]
+        while True:
+            try:
+                packed = [
+                    pack_batch(local, self.oldest_version, self.n_words, caps)
+                    for local in per_shard
+                ]
+                break
+            except KeyWidthError:
+                longest = max(
+                    len(k)
+                    for f in flats
+                    for k in (*f[1], *f[2], *f[5], *f[6])
+                )
+                self._grow_width(longest)
         lay = packed[0].layout
         for pb in packed:
             pb.set_scalars(version_off, oldest_off)

@@ -528,6 +528,36 @@ class ConflictSetTPU:
         )
         self.capacity = new_cap
 
+    def _grow_width(self, min_key_bytes: int) -> None:
+        """Re-pack the resident history at a wider key width (doubling
+        style, so a stream of ever-longer keys costs O(log) rebuilds; the
+        widen itself is a vectorized row insertion, no key decoding).
+
+        This is the in-kernel answer to variable-length keys (SURVEY §7
+        "hard parts"): the packed width follows the data rather than being
+        a hard admission limit — bounded by the deployment key-size knob so
+        a rogue oversized key cannot inflate the state (the reference's
+        key_too_large admission, enforced here server-side)."""
+        from ..core.knobs import CLIENT_KNOBS
+        from .packing import widen_state
+
+        # +1: range END keys may legally be keyAfter(max-size key).
+        cap = CLIENT_KNOBS.KEY_SIZE_LIMIT + 1
+        if min_key_bytes > cap:
+            raise KeyWidthError(
+                f"key of {min_key_bytes} bytes exceeds the deployment "
+                f"key-size limit {cap}"
+            )
+        new_words = min(
+            next_pow2((min_key_bytes + 3) // 4, minimum=self.n_words * 2),
+            next_pow2((cap + 3) // 4),
+        )
+        self.hmat = jnp.asarray(
+            widen_state(np.asarray(self.hmat), self.n_words, new_words)
+        )
+        self.n_words = new_words
+        self.max_key_bytes = 4 * new_words
+
     def resolve_async(
         self, version: int, new_oldest_version: int, pb: PackedBatch
     ) -> PendingResolve:
@@ -601,7 +631,22 @@ class ConflictSetTPU:
         statuses: list[int] = []
         chunks = self._chunks(txns)
         for i, chunk in enumerate(chunks):
-            batch = pack_batch(chunk, self.oldest_version, self.n_words)
+            while True:
+                try:
+                    batch = pack_batch(chunk, self.oldest_version, self.n_words)
+                    break
+                except KeyWidthError:
+                    # Size from the rows the packer actually keeps (tooOld
+                    # txns contribute nothing — same flatten_batch rules).
+                    from .packing import flatten_batch
+
+                    (_, rb, re_, _, _, wb, we, _) = flatten_batch(
+                        chunk, self.oldest_version
+                    )
+                    longest = max(
+                        len(k) for k in (*rb, *re_, *wb, *we)
+                    )
+                    self._grow_width(longest)
             last = i == len(chunks) - 1
             st = self.resolve_packed(
                 version,

@@ -36,9 +36,6 @@ class SimulatedCluster:
             remote(self.cluster.proxy.grv_stream),
             remote(self.cluster.proxy.commit_stream),
             remote(self.cluster.storage.read_stream),
-            resolver_key_width=getattr(
-                self.cluster.resolver.cs, "max_key_bytes", None
-            ),
         )
 
     def database(self) -> Database:

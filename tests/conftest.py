@@ -1,20 +1,37 @@
 """Test configuration.
 
-Tests run on a virtual 8-device CPU mesh: real multi-chip TPU hardware is not
-available in CI, so sharding/collective code paths are validated on the host
-platform with forced device count (the driver separately dry-run-compiles the
-multi-chip path via __graft_entry__.dryrun_multichip). This must be set
-before jax is imported anywhere.
+Backend policy: tests run on JAX's DEFAULT backend — on a machine with a
+TPU attached (like the dev pod, where the `axon` platform registers the
+chip regardless of JAX_PLATFORMS) the differential suite exercises the
+real device; elsewhere it runs on CPU. Multi-device mesh tests use the
+virtual host-platform devices (forced to 8 below), which exist alongside
+whatever the default backend is — sharding/collective code paths are
+validated there, and the driver separately dry-run-compiles the multichip
+path via __graft_entry__.dryrun_multichip.
+
+Heavier device-scale differentials (batch >= 16K) only run when the
+default backend is a real accelerator, or when FDBTPU_BIG=1 forces them.
+
+Env must be set before jax is imported anywhere.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
 
 import pytest  # noqa: E402
+
+
+def big_batches_enabled() -> bool:
+    if os.environ.get("FDBTPU_BIG"):
+        return True
+    import jax
+
+    return jax.default_backend() not in ("cpu",)
 
 
 @pytest.fixture()

@@ -209,16 +209,11 @@ def test_cycle_workload_deterministic():
     assert a1 != b, "different seed should explore a different interleaving"
 
 
-def test_key_width_admission_and_pipeline_survival():
-    """A key at the resolver's packed width is rejected client-side (its
-    conflict-range end wouldn't fit), and even an internal resolver error
-    (malformed request injected past the client checks) fails only its own
-    batch — the pipeline keeps committing afterwards."""
-    from foundationdb_tpu.cluster.interfaces import (
-        CommitTransactionRequest, Mutation,
-    )
-    from foundationdb_tpu.core.errors import KeyTooLarge, OperationFailed
-    from foundationdb_tpu.kv.atomic import MutationType
+def test_key_width_growth_and_pipeline_survival():
+    """Keys beyond the resolver's initial packed width commit fine (the
+    conflict set re-packs itself wider), and an internal resolver failure
+    fails only its own batch — the pipeline keeps committing afterwards."""
+    from foundationdb_tpu.core.errors import OperationFailed
     from foundationdb_tpu.resolver.tpu import ConflictSetTPU
 
     loop = sim_loop(seed=3)
@@ -228,26 +223,24 @@ def test_key_width_admission_and_pipeline_survival():
         db = cluster.database()
 
         async def main():
-            tr = db.create_transaction()
-            with pytest.raises(KeyTooLarge):
-                tr.set(b"x" * 16, b"v")  # width 16: point keys max 15
-            tr.set(b"x" * 15, b"v")  # fits, key_after end is 16 bytes
-            await tr.commit()
+            # 40-byte key through a width-16 conflict set: width growth.
+            await db.set(b"x" * 40, b"v")
+            assert cs.max_key_bytes >= 40
 
-            # Malformed request straight into the proxy: oversized write
-            # range end blows up inside resolution; the batch fails...
-            bad = CommitTransactionRequest(
-                read_snapshot=0, read_conflict_ranges=(),
-                write_conflict_ranges=(),
-                mutations=(Mutation(MutationType.SET_VALUE, b"y" * 40, b"v"),),
-            )
-            cluster.proxy.commit_stream.send(bad)
+            # Inject an internal resolver failure for exactly one batch.
+            real_resolve = cs.resolve
+
+            def boom(*a, **kw):
+                cs.resolve = real_resolve
+                raise RuntimeError("injected resolver failure")
+
+            cs.resolve = boom
             with pytest.raises(OperationFailed):
-                await bad.reply.future
+                await db.set(b"victim", b"v")
             # ...but the pipeline is still alive and sound.
             await db.set(b"alive", b"yes")
             assert await db.get(b"alive") == b"yes"
-            assert await db.get(b"x" * 15) == b"v"
+            assert await db.get(b"x" * 40) == b"v"
             cluster.stop()
 
         loop.run(main(), timeout_sim_seconds=1e6)

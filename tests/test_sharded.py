@@ -164,3 +164,25 @@ def test_sharded_tpu_growth():
             == tpu.resolve(v, 0, txns).statuses
         )
     assert tpu.capacity > 64
+
+
+def test_sharded_width_growth():
+    """Keys beyond the shards' initial packed width widen every shard's
+    state in place (same contract as the single-resolver set)."""
+    bounds = [b"m"]
+    oracle = ShardedConflictSetCPU(bounds)
+    tpu = make_sharded_tpu(bounds, 2, max_key_bytes=8, initial_capacity=64)
+    txns1 = [TxnConflictInfo(0, [], [KeyRange(b"abc", b"abd")])]
+    txns2 = [
+        TxnConflictInfo(
+            5,
+            [KeyRange(b"a" * 40, b"a" * 40 + b"\xff")],
+            [KeyRange(b"z" * 100, b"z" * 100 + b"\x00")],
+        )
+    ]
+    for v, txns in ((10, txns1), (20, txns2), (30, txns1)):
+        assert (
+            oracle.resolve(v, 0, txns).statuses
+            == tpu.resolve(v, 0, txns).statuses
+        )
+    assert tpu.max_key_bytes >= 100
