@@ -1,0 +1,151 @@
+"""Interactive CLI (ref: fdbcli/fdbcli.actor.cpp — the operator shell).
+
+    python -m foundationdb_tpu.cli
+
+Runs a single-process cluster on a real-time event loop and evaluates one
+command per line. Keys/values accept Python bytes-literal escapes
+(e.g. prefix\\x00suffix).
+
+Commands (the fdbcli core surface):
+    get <key>                     read a key
+    set <key> <value>             write a key
+    clear <key>                   clear a key
+    clearrange <begin> <end>      clear a range
+    getrange <begin> <end> [lim]  list key/value pairs
+    status [json]                 cluster status (summary or full JSON)
+    writemode <on|off>            guard mutations like fdbcli does
+    help / exit
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+from .client.database import Database
+from .cluster import LocalCluster
+from .cluster.status import cluster_status
+from .core.runtime import EventLoop, loop_context
+
+
+def _b(token: str) -> bytes:
+    return token.encode("utf-8").decode("unicode_escape").encode("latin-1")
+
+
+def _p(raw: bytes) -> str:
+    return repr(raw)[2:-1]  # b'...' -> ... with escapes
+
+
+class Cli:
+    def __init__(self):
+        self.loop = EventLoop()  # real clock: an interactive tool
+        self._ctx = loop_context(self.loop)
+        self._ctx.__enter__()
+        self.cluster = LocalCluster().start()
+        self.db: Database = self.cluster.database()
+        self.write_mode = False
+
+    def _run(self, coro):
+        task = self.loop.spawn(coro, name="cli")
+        return self.loop.run_until(task.done, timeout_sim_seconds=30)
+
+    def execute(self, line: str) -> str:
+        parts = line.strip().split()
+        if not parts:
+            return ""
+        cmd, args = parts[0].lower(), parts[1:]
+        try:
+            return self._dispatch(cmd, args)
+        except Exception as e:  # noqa: BLE001 — the shell reports, not dies
+            return f"ERROR: {type(e).__name__}: {e}"
+
+    def _need_write_mode(self):
+        if not self.write_mode:
+            raise RuntimeError(
+                "writemode must be enabled to modify the database "
+                "(`writemode on`)"
+            )
+
+    def _dispatch(self, cmd: str, args: list[str]) -> str:
+        db = self.db
+        if cmd == "get":
+            (key,) = args
+            v = self._run(db.get(_b(key)))
+            return f"`{key}' is `{_p(v)}'" if v is not None else f"`{key}': not found"
+        if cmd == "set":
+            key, value = args
+            self._need_write_mode()
+            self._run(db.set(_b(key), _b(value)))
+            return "Committed"
+        if cmd == "clear":
+            (key,) = args
+            self._need_write_mode()
+            self._run(db.clear(_b(key)))
+            return "Committed"
+        if cmd == "clearrange":
+            begin, end = args
+            self._need_write_mode()
+
+            async def body(tr):
+                tr.clear_range(_b(begin), _b(end))
+
+            self._run(db.transact(body))
+            return "Committed"
+        if cmd == "getrange":
+            begin, end = args[0], args[1]
+            limit = int(args[2]) if len(args) > 2 else 25
+
+            async def body(tr):
+                return await tr.get_range(_b(begin), _b(end), limit=limit)
+
+            rows = self._run(db.transact(body))
+            lines = [f"`{_p(k)}' is `{_p(v)}'" for k, v in rows]
+            return "\n".join(lines) if lines else "Range empty"
+        if cmd == "status":
+            st = cluster_status(self.cluster)
+            if args and args[0] == "json":
+                return json.dumps(st, indent=2, default=str)
+            c = st["cluster"]
+            w = c["workload"]["transactions"]
+            return (
+                f"Recovery state: {c['recovery_state']['name']}\n"
+                f"Latest version: {c['latest_version']}\n"
+                f"Committed:      {w['committed']} txns "
+                f"({w['conflicted']} conflicted)\n"
+                f"Roles:          "
+                + ", ".join(r["role"] for r in c["roles"])
+            )
+        if cmd == "writemode":
+            self.write_mode = args and args[0] == "on"
+            return f"writemode {'on' if self.write_mode else 'off'}"
+        if cmd == "help":
+            return __doc__.split("Commands")[1]
+        if cmd in ("exit", "quit"):
+            raise SystemExit(0)
+        return f"ERROR: unknown command `{cmd}' (try help)"
+
+    def close(self):
+        self.cluster.stop()
+        self._ctx.__exit__(None, None, None)
+
+
+def main() -> None:
+    cli = Cli()
+    print("fdbtpu-cli: single-process cluster started (type help)")
+    try:
+        while True:
+            try:
+                line = input("fdbtpu> ")
+            except EOFError:
+                break
+            out = cli.execute(line)
+            if out:
+                print(out)
+    except SystemExit:
+        pass
+    finally:
+        cli.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -59,10 +59,27 @@ class CommitProxy:
         self.commit_stream: PromiseStream[CommitTransactionRequest] = PromiseStream()
         self.grv_stream: PromiseStream[GetReadVersionRequest] = PromiseStream()
         self._tasks = []
-        # Commit statistics (ref: proxy's commit stats TraceEvents).
-        self.txns_committed = 0
-        self.txns_conflicted = 0
-        self.txns_too_old = 0
+        # Commit statistics, flushed periodically as TraceEvents (ref:
+        # ProxyStats, flow/Stats.h:55 CounterCollection).
+        from ..core.stats import CounterCollection
+
+        self.stats = CounterCollection("ProxyStats", id_="proxy")
+        self._c_committed = self.stats.counter("TxnsCommitted")
+        self._c_conflicted = self.stats.counter("TxnsConflicted")
+        self._c_too_old = self.stats.counter("TxnsTooOld")
+        self._c_grv = self.stats.counter("GRVsServed")
+
+    @property
+    def txns_committed(self) -> int:
+        return self._c_committed.total
+
+    @property
+    def txns_conflicted(self) -> int:
+        return self._c_conflicted.total
+
+    @property
+    def txns_too_old(self) -> int:
+        return self._c_too_old.total
 
     def start(self) -> None:
         self._tasks.append(spawn(
@@ -87,8 +104,10 @@ class CommitProxy:
             ),
             TaskPriority.GRV, name="grvBatcher",
         ))
+        self.stats.start_logging(5.0)
 
     def stop(self) -> None:
+        self.stats.stop_logging()
         for t in self._tasks:
             t.cancel()
 
@@ -100,6 +119,7 @@ class CommitProxy:
         ).log()
         for r in reqs:
             if not r.reply.is_set():
+                self._c_grv.add(1)
                 r.reply.send(v)
 
     # -- commit pipeline --
@@ -173,11 +193,11 @@ class CommitProxy:
             if r.reply.is_set():
                 continue
             if status == COMMITTED:
-                self.txns_committed += 1
+                self._c_committed.add(1)
                 r.reply.send(CommitID(version))
             elif status == TOO_OLD:
-                self.txns_too_old += 1
+                self._c_too_old.add(1)
                 r.reply.send_error(TransactionTooOld())
             else:
-                self.txns_conflicted += 1
+                self._c_conflicted.add(1)
                 r.reply.send_error(NotCommitted())

@@ -1,0 +1,88 @@
+"""Machine-readable cluster status (ref: fdbserver/Status.actor.cpp — the
+status JSON assembled by the cluster controller and served to fdbcli /
+operators; schema documented in mr-status.rst).
+
+A subset of the reference schema covering what this cluster has: role
+breakdown with per-role counters, version state, workload totals, and the
+simulator/fault context when present."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..core.runtime import current_loop
+
+
+def cluster_status(cluster) -> dict[str, Any]:
+    loop = current_loop()
+    master = cluster.master
+    resolver = cluster.resolver
+    proxy = cluster.proxy
+    storage = cluster.storage
+    tlog = cluster.tlog
+
+    roles = [
+        {
+            "role": "master",
+            "latest_version": master.version,
+            "committed_version": master.committed.get(),
+        },
+        {
+            "role": "proxy",
+            "txns_committed": proxy.txns_committed,
+            "txns_conflicted": proxy.txns_conflicted,
+            "txns_too_old": proxy.txns_too_old,
+            "commit_batches_in_flight": len(proxy.commit_stream),
+        },
+        {
+            "role": "resolver",
+            "version": resolver.version.get(),
+            "conflict_batches": resolver.conflict_batches,
+            "total_transactions": resolver.total_transactions,
+            "conflict_transactions": resolver.conflict_transactions,
+            "conflict_set": type(resolver.cs).__name__,
+        },
+        {
+            "role": "log",
+            "version": tlog.version.get(),
+            "durable_version": tlog.durable.get(),
+            "popped_version": tlog.popped,
+            "queue_entries": len(tlog._entries),
+        },
+        {
+            "role": "storage",
+            "data_version": storage.version.get(),
+            "oldest_version": storage.oldest_version,
+            "keys": len(storage.data),
+            "durability_lag_versions": (
+                tlog.durable.get() - storage.version.get()
+            ),
+            "active_watches": len(storage._watches),
+        },
+    ]
+
+    committed = proxy.txns_committed
+    conflicted = proxy.txns_conflicted + proxy.txns_too_old
+    return {
+        "client": {
+            "database_status": {"available": True},
+            "cluster_file": {"up_to_date": True},
+        },
+        "cluster": {
+            "generation": 1,  # recovery generations arrive with the
+            # coordination tier (SURVEY §7 step 5)
+            "latest_version": master.version,
+            "committed_version": master.committed.get(),
+            "recovery_state": {"name": "fully_recovered"},
+            "machine_time": loop.now(),
+            "simulated": loop.is_simulated(),
+            "roles": roles,
+            "workload": {
+                "transactions": {
+                    "committed": committed,
+                    "conflicted": conflicted,
+                    "started": committed + conflicted,
+                }
+            },
+        },
+    }

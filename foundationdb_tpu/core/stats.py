@@ -1,0 +1,78 @@
+"""Counters and periodic stats emission (ref: flow/Stats.h:55-63 —
+Counter/CounterCollection flushed as TraceEvents on an interval).
+
+Each flush emits one TraceEvent per collection carrying every counter's
+CUMULATIVE total plus its rate over the window since the previous flush
+(the window then resets) — the shape operators' dashboards scrape in the
+reference: totals for monotonic series, rates for gauges."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .runtime import Task, current_loop, spawn
+from .trace import TraceEvent
+
+
+class Counter:
+    __slots__ = ("name", "total", "_window")
+
+    def __init__(self, name: str, collection: "CounterCollection" = None):
+        self.name = name
+        self.total = 0
+        self._window = 0
+        if collection is not None:
+            collection.add(self)
+
+    def add(self, n: int = 1) -> None:
+        self.total += n
+        self._window += n
+
+    def __iadd__(self, n: int) -> "Counter":
+        self.add(n)
+        return self
+
+
+class CounterCollection:
+    def __init__(self, name: str, id_: str = ""):
+        self.name = name
+        self.id = id_
+        self.counters: list[Counter] = []
+        self._task: Optional[Task] = None
+
+    def add(self, counter: Counter) -> None:
+        self.counters.append(counter)
+
+    def counter(self, name: str) -> Counter:
+        return Counter(name, self)
+
+    def flush(self, elapsed: float) -> None:
+        ev = TraceEvent(self.name + "Metrics").detail("ID", self.id).detail(
+            "Elapsed", round(elapsed, 6)
+        )
+        for c in self.counters:
+            ev.detail(c.name, c.total)
+            rate = c._window / elapsed if elapsed > 0 else 0.0
+            ev.detail(c.name + "Rate", round(rate, 3))
+            c._window = 0
+        ev.log()
+
+    def start_logging(self, interval: float) -> None:
+        """Emit a metrics TraceEvent every `interval` seconds (ref:
+        traceCounters, flow/Stats.actor.cpp)."""
+
+        async def run():
+            loop = current_loop()
+            last = loop.now()
+            while True:
+                await loop.delay(interval)
+                now = loop.now()
+                self.flush(now - last)
+                last = now
+
+        self._task = spawn(run(), name=f"counters:{self.name}")
+
+    def stop_logging(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None

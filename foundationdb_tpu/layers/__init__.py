@@ -1,0 +1,5 @@
+"""Client-side layers (ref: the tuple/subspace layers every binding ships,
+fdbclient/Tuple.cpp + bindings/python/fdb/tuple.py, spec design/tuple.md)."""
+
+from .tuple import pack, range_of, unpack  # noqa: F401
+from .subspace import Subspace  # noqa: F401

@@ -199,7 +199,7 @@ class ShardedConflictSetTPU:
         shard's packed state (vectorized row insertion), capped by the
         deployment key-size knob."""
         from ..core.knobs import CLIENT_KNOBS
-        from .packing import KeyWidthError, widen_state
+        from .packing import widen_state
 
         cap = CLIENT_KNOBS.KEY_SIZE_LIMIT + 1
         if min_key_bytes > cap:

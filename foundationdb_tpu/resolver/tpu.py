@@ -628,25 +628,24 @@ class ConflictSetTPU:
         new_oldest_version: int,
         txns: Sequence[TxnConflictInfo],
     ) -> ConflictBatchResult:
+        # Width admission/growth happens ONCE, up front, over the rows the
+        # packer will actually keep: a mid-batch width failure after some
+        # chunks already merged their writes would break the all-abort
+        # invariant the proxy's failure containment relies on
+        # (resolver_role.py: "a failed batch commits NOTHING").
+        from .packing import flatten_batch
+
+        (_, rb, re_, _, _, wb, we, _) = flatten_batch(txns, self.oldest_version)
+        longest = max(
+            (len(k) for k in (*rb, *re_, *wb, *we)), default=0
+        )
+        if longest > self.max_key_bytes:
+            self._grow_width(longest)
+
         statuses: list[int] = []
         chunks = self._chunks(txns)
         for i, chunk in enumerate(chunks):
-            while True:
-                try:
-                    batch = pack_batch(chunk, self.oldest_version, self.n_words)
-                    break
-                except KeyWidthError:
-                    # Size from the rows the packer actually keeps (tooOld
-                    # txns contribute nothing — same flatten_batch rules).
-                    from .packing import flatten_batch
-
-                    (_, rb, re_, _, _, wb, we, _) = flatten_batch(
-                        chunk, self.oldest_version
-                    )
-                    longest = max(
-                        len(k) for k in (*rb, *re_, *wb, *we)
-                    )
-                    self._grow_width(longest)
+            batch = pack_batch(chunk, self.oldest_version, self.n_words)
             last = i == len(chunks) - 1
             st = self.resolve_packed(
                 version,

@@ -1,0 +1,209 @@
+"""Tuple/Subspace layers, KeyRangeMap, counters, status JSON, CLI."""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from foundationdb_tpu.kv.keyrange_map import KeyRangeMap
+from foundationdb_tpu.kv.keys import KeyRange
+from foundationdb_tpu.layers import Subspace
+from foundationdb_tpu.layers import tuple as tl
+
+
+# ---- tuple layer ----
+
+SAMPLES = [
+    (),
+    (None,),
+    (b"bytes", b"with\x00null"),
+    ("unicodeé", "",),
+    (0, 1, -1, 255, 256, -255, -256, 2**40, -(2**40), 2**100, -(2**100)),
+    (3.14, -2.5, 0.0, float("inf")),
+    (True, False),
+    ((b"nested", (1, None)), 2),
+]
+
+
+def test_tuple_roundtrip():
+    for t in SAMPLES:
+        assert tl.unpack(tl.pack(t)) == t
+
+
+def test_tuple_order_preservation():
+    """The defining property: byte order of pack == semantic tuple order."""
+    rng = np.random.default_rng(0)
+
+    def rand_elem():
+        k = rng.integers(0, 4)
+        if k == 0:
+            return int(rng.integers(-(2**40), 2**40))
+        if k == 1:
+            return bytes(rng.integers(0, 256, int(rng.integers(0, 6)),
+                                      dtype=np.uint8))
+        if k == 2:
+            return float(np.round(rng.normal() * 100, 3))
+        return bool(rng.integers(0, 2))
+
+    def type_rank(x):
+        # Spec order: null < bytes < str < nested < int < double < bool.
+        if isinstance(x, bool):
+            return 6
+        if isinstance(x, bytes):
+            return 1
+        if isinstance(x, int):
+            return 4
+        if isinstance(x, float):
+            return 5
+        raise AssertionError
+
+    def tuple_lt(a, b):
+        for x, y in zip(a, b):
+            rx, ry = type_rank(x), type_rank(y)
+            if rx != ry:
+                return rx < ry
+            if x != y:
+                return x < y
+        return len(a) < len(b)
+
+    tuples = [tuple(rand_elem() for _ in range(int(rng.integers(0, 4))))
+              for _ in range(300)]
+    packed = [(tl.pack(t), t) for t in tuples]
+    for i in range(len(packed)):
+        for j in range(i + 1, len(packed)):
+            (pa, a), (pb, b) = packed[i], packed[j]
+            if a == b:
+                assert pa == pb
+            elif tuple_lt(a, b):
+                assert pa < pb, (a, b)
+            else:
+                assert pb < pa, (a, b)
+
+
+def test_tuple_range():
+    begin, end = tl.range_of((b"users",))
+    assert begin < tl.pack((b"users", 1)) < end
+    assert begin < tl.pack((b"users", b"zz", 5)) < end
+    assert not (begin <= tl.pack((b"userz",)) < end)
+
+
+def test_subspace():
+    s = Subspace((b"app",))["users"]
+    k = s.pack((42, b"row"))
+    assert s.contains(k)
+    assert s.unpack(k) == (42, b"row")
+    b, e = s.range()
+    assert b < k < e
+    with pytest.raises(ValueError):
+        Subspace((b"other",)).unpack(k)
+
+
+# ---- KeyRangeMap ----
+
+def test_keyrange_map():
+    m = KeyRangeMap(default="none")
+    assert m[b"anything"] == "none"
+    m.insert(KeyRange(b"b", b"f"), "A")
+    m.insert(KeyRange(b"d", b"e"), "B")
+    assert m[b"a"] == "none"
+    assert m[b"b"] == "A"
+    assert m[b"d"] == "B"
+    assert m[b"e"] == "A"
+    assert m[b"f"] == "none"
+    # Overwrite + coalesce back to one range.
+    m.insert(KeyRange(b"d", b"e"), "A")
+    assert [v for _, _, v in m.ranges()] == ["none", "A", "none"]
+    steps = m.intersecting(KeyRange(b"c", b"zz"))
+    assert steps[0][2] == "A" and steps[-1][2] == "none"
+
+
+# ---- counters ----
+
+def test_counter_collection_flush(sim):
+    from foundationdb_tpu.core.stats import CounterCollection
+    from foundationdb_tpu.core.trace import TraceSink, set_global_sink
+
+    sink = TraceSink()
+    set_global_sink(sink)
+    cc = CounterCollection("ProxyStats", id_="proxy0")
+    commits = cc.counter("TxnCommitted")
+    cc.start_logging(1.0)
+
+    async def main():
+        from foundationdb_tpu.core.runtime import current_loop
+
+        for _ in range(5):
+            commits.add(1)
+        await current_loop().delay(1.5)
+        commits.add(3)
+        await current_loop().delay(1.0)
+        cc.stop_logging()
+
+    sim.run(main())
+    evs = sink.find("ProxyStatsMetrics")
+    assert len(evs) == 2
+    assert evs[0]["TxnCommitted"] == 5 and evs[0]["TxnCommittedRate"] == 5.0
+    assert evs[1]["TxnCommitted"] == 8  # totals are cumulative
+    assert commits.total == 8
+
+
+# ---- status ----
+
+def test_cluster_status():
+    from foundationdb_tpu.cluster import LocalCluster
+    from foundationdb_tpu.cluster.status import cluster_status
+    from foundationdb_tpu.core.runtime import loop_context, sim_loop
+
+    loop = sim_loop(seed=1)
+    with loop_context(loop):
+        cluster = LocalCluster().start()
+        db = cluster.database()
+
+        async def main():
+            from foundationdb_tpu.core.runtime import current_loop
+
+            await db.set(b"a", b"1")
+            await db.set(b"b", b"2")
+            # Storage ingests asynchronously; let it catch up for the
+            # key-count snapshot.
+            await current_loop().delay(0.2)
+            st = cluster_status(cluster)
+            cluster.stop()
+            return st
+
+        st = loop.run(main(), 1e6)
+    c = st["cluster"]
+    assert c["workload"]["transactions"]["committed"] == 2
+    roles = {r["role"]: r for r in c["roles"]}
+    assert set(roles) == {"master", "proxy", "resolver", "log", "storage"}
+    assert roles["storage"]["keys"] == 2
+    assert roles["resolver"]["total_transactions"] == 2
+    assert c["committed_version"] <= c["latest_version"]
+    json.dumps(st)  # must be serializable
+
+
+# ---- CLI ----
+
+def test_cli_end_to_end():
+    script = "\n".join([
+        "writemode on",
+        "set hello world",
+        "set hellp x",
+        "get hello",
+        "getrange hell hellz 10",
+        "clear hellp",
+        "getrange hell hellz 10",
+        "status",
+        "exit",
+    ]) + "\n"
+    out = subprocess.run(
+        [sys.executable, "-m", "foundationdb_tpu.cli"],
+        input=script, capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "`hello' is `world'" in out.stdout
+    assert "Recovery state: fully_recovered" in out.stdout
+    # After the clear, the range lists only one row.
+    assert out.stdout.count("`hellp' is") == 1
