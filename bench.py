@@ -252,9 +252,11 @@ def measure_tpu(batch_txns: int, n_batches: int, key_space: int, seed: int,
         name = "batch_64k"
         rng = np.random.default_rng(seed + 2)
         sampler = uniform_sampler(key_space)
-        # Pre-size so the pessimistic growth bound (entries + 2*writes per
-        # batch) never triggers a mid-run grow+recompile.
-        cs = ConflictSetTPU(max_key_bytes=8, initial_capacity=2 * capacity)
+        # Synchronous per-batch result() refreshes the exact entry count,
+        # so the pessimistic growth bound stays under `capacity` for this
+        # run length — no mid-run grow+recompile, and no oversized state
+        # (a larger C would slow every history-scaled pass).
+        cs = ConflictSetTPU(max_key_bytes=8, initial_capacity=capacity)
         lat = []
         v = 1_000_000
         nb = 4

@@ -15,6 +15,7 @@ from __future__ import annotations
 from ..resolver.cpu import ConflictSetCPU
 from .master import Master
 from .proxy import CommitProxy
+from .ratekeeper import Ratekeeper
 from .resolver_role import ResolverRole
 from .storage import StorageServer
 from .tlog import MemoryTLog
@@ -29,18 +30,22 @@ class LocalCluster:
         )
         self.tlog = MemoryTLog(init_version)
         self.storage = StorageServer(self.tlog, init_version)
-        self.proxy = CommitProxy(self.master, self.resolver, self.tlog)
+        self.ratekeeper = Ratekeeper(self.tlog, self.storage)
+        self.proxy = CommitProxy(self.master, self.resolver, self.tlog,
+                                 ratekeeper=self.ratekeeper)
         self._started = False
 
     def start(self) -> "LocalCluster":
         assert not self._started
         self._started = True
         self.storage.start()
+        self.ratekeeper.start()
         self.proxy.start()
         return self
 
     def stop(self) -> None:
         self.proxy.stop()
+        self.ratekeeper.stop()
         self.storage.stop()
         self._started = False
 

@@ -22,7 +22,7 @@ version, so a read version can never precede a commit it was issued after.
 
 from __future__ import annotations
 
-from ..core.actors import PromiseStream
+from ..core.actors import ActorCollection, PromiseStream
 from ..core.errors import NotCommitted, TransactionTooOld
 from ..core.knobs import CLIENT_KNOBS, SERVER_KNOBS
 from ..core.runtime import TaskPriority, buggify, current_loop, spawn
@@ -52,13 +52,15 @@ def mutation_write_ranges(m: Mutation) -> KeyRange:
 
 
 class CommitProxy:
-    def __init__(self, master: Master, resolver: ResolverRole, tlog: MemoryTLog):
+    def __init__(self, master: Master, resolver: ResolverRole, tlog: MemoryTLog,
+                 ratekeeper=None):
         self.master = master
         self.resolver = resolver
         self.tlog = tlog
+        self.ratekeeper = ratekeeper
         self.commit_stream: PromiseStream[CommitTransactionRequest] = PromiseStream()
         self.grv_stream: PromiseStream[GetReadVersionRequest] = PromiseStream()
-        self._tasks = []
+        self._tasks = ActorCollection()
         # Commit statistics, flushed periodically as TraceEvents (ref:
         # ProxyStats, flow/Stats.h:55 CounterCollection).
         from ..core.stats import CounterCollection
@@ -68,6 +70,7 @@ class CommitProxy:
         self._c_conflicted = self.stats.counter("TxnsConflicted")
         self._c_too_old = self.stats.counter("TxnsTooOld")
         self._c_grv = self.stats.counter("GRVsServed")
+        self._c_grv_throttled = self.stats.counter("GRVsThrottled")
 
     @property
     def txns_committed(self) -> int:
@@ -82,7 +85,7 @@ class CommitProxy:
         return self._c_too_old.total
 
     def start(self) -> None:
-        self._tasks.append(spawn(
+        self._tasks.add(spawn(
             batcher(
                 self.commit_stream,
                 lambda b: spawn(
@@ -94,7 +97,7 @@ class CommitProxy:
             ),
             TaskPriority.PROXY_COMMIT, name="commitBatcher",
         ))
-        self._tasks.append(spawn(
+        self._tasks.add(spawn(
             batcher(
                 self.grv_stream,
                 self._answer_grv_batch,
@@ -108,11 +111,36 @@ class CommitProxy:
 
     def stop(self) -> None:
         self.stats.stop_logging()
-        for t in self._tasks:
-            t.cancel()
+        self._tasks.cancel_all()
 
     # -- GRV --
     def _answer_grv_batch(self, reqs: list[GetReadVersionRequest]) -> None:
+        # Admission control: when the ratekeeper's budget is exhausted the
+        # batch is deferred, not denied — GRVs simply start later, which is
+        # exactly how the reference's transactionStarter applies the rate
+        # (MasterProxyServer.actor.cpp:85-150).
+        rk = self.ratekeeper
+        if rk is not None:
+            admitted = rk.admit_transactions(len(reqs))
+            if admitted < len(reqs):
+                deferred = reqs[admitted:]
+                reqs = reqs[:admitted]
+                self._c_grv_throttled.add(len(deferred))
+                TraceEvent("ProxyGRVThrottled").detail(
+                    "Count", len(deferred)
+                ).log()
+
+                async def requeue():
+                    await current_loop().delay(0.05)
+                    for r in deferred:
+                        if not r.reply.is_set():
+                            self.grv_stream.send(r)
+
+                self._tasks.add(
+                    spawn(requeue(), TaskPriority.GRV, name="grvThrottle")
+                )
+                if not reqs:
+                    return
         v = self.master.get_live_committed_version()
         TraceEvent("ProxyGRV").detail("Version", v).detail(
             "Count", len(reqs)

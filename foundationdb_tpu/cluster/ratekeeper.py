@@ -1,0 +1,87 @@
+"""Ratekeeper: cluster-wide admission control (ref:
+fdbserver/Ratekeeper.actor.cpp).
+
+The reference tracks every storage server's and tlog's queue depth
+(StorageQueueInfo :77) and computes a transactions-per-second budget from
+the worst queues (updateRate :253-513); the master distributes the rate to
+proxies, which delay GRVs so new transactions start no faster than the
+cluster drains (MasterProxyServer.actor.cpp:85-150). Same control loop
+here: the monitored signals are the storage node's version lag behind the
+durable log (the MVCC pipeline's queue) and the log's unpopped backlog;
+the actuator is a token bucket consulted by the proxy's GRV batcher.
+"""
+
+from __future__ import annotations
+
+from ..core.knobs import SERVER_KNOBS
+from ..core.runtime import Task, current_loop, spawn
+from ..core.trace import TraceEvent
+
+
+class Ratekeeper:
+    def __init__(self, tlog, storage):
+        self.tlog = tlog
+        self.storage = storage
+        self.tps_limit = float("inf")
+        self._tokens = 0.0
+        self._last_refill = 0.0
+        self._task: Task | None = None
+        # Control targets (ref: Knobs TARGET_BYTES_PER_STORAGE_SERVER /
+        # MAX_VERSION_DIFFERENCE family, restated in version-lag terms).
+        self.target_lag_versions = SERVER_KNOBS.STORAGE_DURABILITY_LAG_VERSIONS // 10
+        self.max_lag_versions = SERVER_KNOBS.STORAGE_DURABILITY_LAG_VERSIONS
+
+    def start(self) -> None:
+        self._task = spawn(self._update_loop(), name="ratekeeper")
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+
+    # -- control loop (ref: updateRate) --
+    def _compute_rate(self) -> float:
+        lag = self.tlog.durable.get() - self.storage.version.get()
+        if lag <= self.target_lag_versions:
+            return float("inf")
+        if lag >= self.max_lag_versions:
+            return 0.0
+        # Linear back-off between target and max, against a nominal
+        # full-speed rate (the reference smooths against measured release
+        # rates; the shape of the controller is what matters here).
+        frac = 1.0 - (lag - self.target_lag_versions) / (
+            self.max_lag_versions - self.target_lag_versions
+        )
+        return max(10.0, frac * 100_000.0)
+
+    async def _update_loop(self):
+        loop = current_loop()
+        while True:
+            await loop.delay(SERVER_KNOBS.RATEKEEPER_UPDATE_INTERVAL)
+            new_rate = self._compute_rate()
+            if new_rate != self.tps_limit:
+                TraceEvent("RkUpdate").detail("TPSLimit", new_rate).detail(
+                    "DurabilityLag",
+                    self.tlog.durable.get() - self.storage.version.get(),
+                ).log()
+            self.tps_limit = new_rate
+
+    # -- actuator: token bucket the GRV batcher draws on --
+    def admit_transactions(self, n: int) -> int:
+        """How many of n new transactions may start now (a PREFIX of the
+        batch — the rest is deferred). Admitting prefixes rather than
+        all-or-nothing means a batch larger than one second of budget
+        still trickles through at the limit instead of starving (ref: the
+        proxy's transactionStarter draining its rate budget)."""
+        if self.tps_limit == float("inf"):
+            return n
+        loop = current_loop()
+        now = loop.now()
+        elapsed = now - self._last_refill
+        self._last_refill = now
+        self._tokens = min(
+            max(self.tps_limit, 1.0),  # burst cap: one second of budget
+            self._tokens + elapsed * self.tps_limit,
+        )
+        k = min(n, int(self._tokens))
+        self._tokens -= k
+        return k

@@ -60,6 +60,8 @@ class KeyRangeMap:
 
     def intersecting(self, r: KeyRange) -> list[tuple[bytes, Optional[bytes], Any]]:
         """(begin, end|None, value) steps overlapping [r.begin, r.end)."""
+        if r.is_empty():
+            return []
         lo = bisect_right(self._keys, r.begin) - 1
         hi = bisect_left(self._keys, r.end)
         out = []

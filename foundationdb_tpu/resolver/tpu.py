@@ -629,16 +629,22 @@ class ConflictSetTPU:
         txns: Sequence[TxnConflictInfo],
     ) -> ConflictBatchResult:
         # Width admission/growth happens ONCE, up front, over the rows the
-        # packer will actually keep: a mid-batch width failure after some
-        # chunks already merged their writes would break the all-abort
-        # invariant the proxy's failure containment relies on
-        # (resolver_role.py: "a failed batch commits NOTHING").
-        from .packing import flatten_batch
-
-        (_, rb, re_, _, _, wb, we, _) = flatten_batch(txns, self.oldest_version)
-        longest = max(
-            (len(k) for k in (*rb, *re_, *wb, *we)), default=0
-        )
+        # packer will actually keep (same rules as flatten_batch: tooOld
+        # txns and empty ranges contribute nothing): a mid-batch width
+        # failure after some chunks already merged their writes would
+        # break the all-abort invariant the proxy's failure containment
+        # relies on (resolver_role.py: "a failed batch commits NOTHING").
+        # A plain scan, no list materialization — this is the hot path.
+        longest = 0
+        for t in txns:
+            if t.read_snapshot < self.oldest_version and t.read_ranges:
+                continue
+            for r in t.read_ranges:
+                if not r.is_empty():
+                    longest = max(longest, len(r.begin), len(r.end))
+            for w in t.write_ranges:
+                if not w.is_empty():
+                    longest = max(longest, len(w.begin), len(w.end))
         if longest > self.max_key_bytes:
             self._grow_width(longest)
 
