@@ -1,0 +1,32 @@
+"""Randomized API correctness vs the in-memory model (ref: ApiCorrectness /
+WriteDuringRead family) — across seeds, on the full stack under sim."""
+
+import pytest
+
+from foundationdb_tpu.cluster import LocalCluster
+from foundationdb_tpu.core.runtime import loop_context, sim_loop
+from foundationdb_tpu.workloads.api_correctness import ApiCorrectnessWorkload
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3, 4, 5, 6])
+def test_api_correctness_random_ops(seed):
+    loop = sim_loop(seed=seed)
+    with loop_context(loop):
+        cluster = LocalCluster().start()
+        db = cluster.database()
+
+        async def main():
+            wl = ApiCorrectnessWorkload(db, key_space=30)
+            await wl.run(txns=60)
+            # Final state: the database must equal the model exactly.
+            rows = await db.transact(
+                lambda tr: tr.get_range(b"api/", b"api0", limit=0)
+            )
+            model_rows = wl.model.get_range(b"api/", b"api0")
+            cluster.stop()
+            return wl, rows, model_rows
+
+        wl, rows, model_rows = loop.run(main(), timeout_sim_seconds=1e6)
+    assert wl.check(), wl.mismatches[:5]
+    assert rows == model_rows
+    assert wl.txns_done == 60 and wl.ops_done >= 60
