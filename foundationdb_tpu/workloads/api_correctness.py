@@ -16,8 +16,10 @@ from __future__ import annotations
 from typing import Optional
 
 from ..client.database import Database
+from ..core.errors import CommitUnknownResult
 from ..core.runtime import current_loop
 from ..kv.atomic import MutationType, apply_atomic
+from ..kv.keys import key_after
 
 
 class ModelKV:
@@ -91,7 +93,15 @@ class ApiCorrectnessWorkload:
         tr = self.db.create_transaction()
         while True:
             staged = self.model.clone()
+            # Per-attempt marker: resolves the maybe-committed ambiguity.
+            # A lost commit reply (commit_unknown_result) from an attempt
+            # that actually landed would otherwise leave non-idempotent
+            # mutations in the database but not the model — the reference's
+            # self-checking workloads use the same dedup-key pattern.
+            marker = self.prefix + b"txn-%016x" % rng.random_int(0, 2**62)
             try:
+                tr.set(marker, b"1")
+                staged.set(marker, b"1")
                 n_ops = rng.random_int(1, 9)
                 for _ in range(n_ops):
                     await self._one_op(tr, staged)
@@ -101,7 +111,12 @@ class ApiCorrectnessWorkload:
                 self.txns_done += 1
                 return
             except BaseException as e:  # noqa: BLE001
+                unknown = isinstance(e, CommitUnknownResult)
                 await tr.on_error(e)
+                if unknown and await self.db.get(marker) is not None:
+                    self.model = staged
+                    self.txns_done += 1
+                    return
 
     async def _one_op(self, tr, staged: ModelKV) -> None:
         rng = current_loop().random
@@ -130,7 +145,7 @@ class ApiCorrectnessWorkload:
         elif kind == 3:
             k = self._key()
             tr.clear(k)
-            staged.clear_range(k, k + b"\x00")
+            staged.clear_range(k, key_after(k))
         elif kind == 4:
             a, b = sorted((self._key(), self._key()))
             tr.clear_range(a, b)

@@ -30,3 +30,32 @@ def test_api_correctness_random_ops(seed):
     assert wl.check(), wl.mismatches[:5]
     assert rows == model_rows
     assert wl.txns_done == 60 and wl.ops_done >= 60
+
+
+@pytest.mark.parametrize("seed", [21, 22])
+def test_api_correctness_under_network_faults(seed):
+    """The model must track the database exactly even when lost commit
+    replies surface as commit_unknown_result — the per-attempt marker keys
+    resolve the maybe-committed ambiguity."""
+    from foundationdb_tpu.sim import SimulatedCluster
+
+    loop = sim_loop(seed=seed, buggify=True)
+    with loop_context(loop):
+        sc = SimulatedCluster()
+        db = sc.database()
+
+        async def main():
+            wl = ApiCorrectnessWorkload(db, key_space=20)
+            sc.start_random_clogging(mean_interval=0.05, max_clog=0.3)
+            sc.start_attrition(mean_interval=2.0, max_outage=1.0)
+            await wl.run(txns=40)
+            rows = await db.transact(
+                lambda tr: tr.get_range(b"api/", b"api0", limit=0)
+            )
+            model_rows = wl.model.get_range(b"api/", b"api0")
+            sc.stop()
+            return wl, rows, model_rows
+
+        wl, rows, model_rows = loop.run(main(), timeout_sim_seconds=1e6)
+    assert wl.check(), wl.mismatches[:5]
+    assert rows == model_rows
