@@ -1,0 +1,196 @@
+"""Coordination: quorum-replicated generation registers + leader election
+(ref: fdbserver/Coordination.actor.cpp:125 localGenerationReg,
+CoordinatedState.actor.cpp read/write quorum state machine,
+LeaderElection.actor.cpp:78 tryBecomeLeaderInternal).
+
+The coordinators are the cluster's root of trust: a small set of register
+servers answering two-phase reads/writes with generation numbers, so that
+a new master generation can fence out every older one (split-brain safety)
+without any single server being trusted. The protocol here is the
+reference's (Paxos-flavored, specialized to a single register):
+
+  read(gen):   quorum of coordinators bump their read-generation to `gen`
+               and return their (value, write_generation); the reader takes
+               the value with the highest write generation.
+  write(gen, v): quorum accepts iff `gen` >= their read/write generations;
+               any later read(gen') with gen' > gen observes it.
+
+A candidate that reads with a fresh generation and then writes with it is
+guaranteed: either its write succeeds at a quorum (it owns the epoch) or a
+newer generation has been seen (it must retire). Leader election layers a
+lease on top: the elected leader's identity + lease expiry live in the
+registers, heartbeats extend the lease, and a candidate may only take over
+after the lease lapses.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from ..core.errors import OperationFailed
+from ..core.runtime import current_loop
+from ..core.trace import TraceEvent
+
+
+@dataclass
+class _RegState:
+    read_gen: int = 0
+    write_gen: int = 0
+    value: Any = None
+
+
+class CoordinatorRegister:
+    """One register server hosting KEYED generation registers (ref:
+    localGenerationReg serves a keyspace of registers — leader seat,
+    cluster state — not one slot). In-memory here; its state durability
+    story rides the storage-engine tier the same way the reference's rides
+    OnDemandStore."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.regs: dict[str, _RegState] = {}
+        self.available = True  # fault hook for tests
+
+    def _reg(self, key: str) -> _RegState:
+        s = self.regs.get(key)
+        if s is None:
+            s = self.regs[key] = _RegState()
+        return s
+
+    def read(self, key: str, gen: int) -> tuple[Any, int]:
+        if not self.available:
+            raise OperationFailed(f"coordinator {self.name} unavailable")
+        s = self._reg(key)
+        s.read_gen = max(s.read_gen, gen)
+        return s.value, s.write_gen
+
+    def write(self, key: str, gen: int, value: Any) -> bool:
+        if not self.available:
+            raise OperationFailed(f"coordinator {self.name} unavailable")
+        s = self._reg(key)
+        if gen < s.read_gen or gen < s.write_gen:
+            return False
+        s.write_gen = gen
+        s.value = value
+        return True
+
+
+class CoordinatedState:
+    """Client side of the quorum protocol for ONE keyed register (ref:
+    CoordinatedState + ReusableCoordinatedState, masterserver.actor.cpp:78)."""
+
+    def __init__(self, coordinators: list[CoordinatorRegister], key: str = "state"):
+        self.coordinators = coordinators
+        self.key = key
+        self.quorum = len(coordinators) // 2 + 1
+
+    def _fresh_gen(self) -> int:
+        # Monotone, collision-avoiding generation: sim-time tick + entropy.
+        loop = current_loop()
+        return int(loop.now() * 1_000_000) * 64 + loop.random.random_int(0, 64)
+
+    def read(self, gen: int) -> Any:
+        """Quorum read at `gen`; returns the value with the highest write
+        generation among responders."""
+        best, best_gen, ok = None, -1, 0
+        for c in self.coordinators:
+            try:
+                value, wgen = c.read(self.key, gen)
+            except OperationFailed:
+                continue
+            ok += 1
+            if wgen > best_gen:
+                best, best_gen = value, wgen
+        if ok < self.quorum:
+            raise OperationFailed("coordination quorum unavailable for read")
+        return best
+
+    def write(self, gen: int, value: Any) -> bool:
+        """Quorum write at `gen`. False = fenced by a newer generation."""
+        accepted, reachable = 0, 0
+        for c in self.coordinators:
+            try:
+                if c.write(self.key, gen, value):
+                    accepted += 1
+                reachable += 1
+            except OperationFailed:
+                continue
+        if reachable < self.quorum:
+            raise OperationFailed("coordination quorum unavailable for write")
+        return accepted >= self.quorum
+
+    def read_modify_write(self, update) -> tuple[int, Any]:
+        """One fenced transition: read current, apply `update`, write —
+        retrying with a fresher generation when raced. Returns (gen, new)."""
+        while True:
+            gen = self._fresh_gen()
+            current = self.read(gen)
+            new = update(current)
+            if self.write(gen, new):
+                return gen, new
+            # Raced by a newer generation; re-read and try again.
+
+
+@dataclass
+class LeaderLease:
+    leader: str
+    epoch: int
+    expires: float
+
+
+class LeaderElection:
+    """Lease-based election over the coordinated state (ref:
+    tryBecomeLeaderInternal's nominee + heartbeat loop)."""
+
+    def __init__(self, cstate: CoordinatedState, lease_seconds: float = 1.0):
+        self.cstate = cstate
+        self.lease_seconds = lease_seconds
+
+    def try_become_leader(self, who: str) -> Optional[LeaderLease]:
+        """Claim leadership if the seat is free or the lease lapsed.
+        Returns the lease when `who` is (now) the leader, else None."""
+        loop = current_loop()
+
+        def update(cur):
+            if (
+                cur is not None
+                and cur.leader != who
+                and cur.expires > loop.now()
+            ):
+                return cur  # live leader elsewhere: no change
+            if cur is None:
+                epoch = 1
+            elif cur.leader == who:
+                epoch = cur.epoch  # renewing our own seat
+            else:
+                epoch = cur.epoch + 1  # taking over a lapsed seat
+            return LeaderLease(
+                leader=who, epoch=epoch,
+                expires=loop.now() + self.lease_seconds,
+            )
+
+        _, new = self.cstate.read_modify_write(update)
+        if new.leader == who:
+            TraceEvent("LeaderElected").detail("Leader", who).detail(
+                "Epoch", new.epoch
+            ).log()
+            return new
+        return None
+
+    def heartbeat(self, lease: LeaderLease) -> Optional[LeaderLease]:
+        """Extend the lease; None = deposed (a newer epoch took over)."""
+        loop = current_loop()
+
+        def update(cur):
+            if cur is None or cur.leader != lease.leader or cur.epoch != lease.epoch:
+                return cur  # deposed: leave the register alone
+            return LeaderLease(
+                leader=lease.leader, epoch=lease.epoch,
+                expires=loop.now() + self.lease_seconds,
+            )
+
+        _, new = self.cstate.read_modify_write(update)
+        if new is not None and new.leader == lease.leader and new.epoch == lease.epoch:
+            return new
+        return None

@@ -23,7 +23,7 @@ version, so a read version can never precede a commit it was issued after.
 from __future__ import annotations
 
 from ..core.actors import ActorCollection, PromiseStream
-from ..core.errors import NotCommitted, TransactionTooOld
+from ..core.errors import NotCommitted, OperationFailed, TLogStopped, TransactionTooOld
 from ..core.knobs import CLIENT_KNOBS, SERVER_KNOBS
 from ..core.runtime import TaskPriority, buggify, current_loop, spawn
 from ..core.trace import TraceEvent
@@ -53,11 +53,12 @@ def mutation_write_ranges(m: Mutation) -> KeyRange:
 
 class CommitProxy:
     def __init__(self, master: Master, resolver: ResolverRole, tlog: MemoryTLog,
-                 ratekeeper=None):
+                 ratekeeper=None, generation: int = 0):
         self.master = master
         self.resolver = resolver
         self.tlog = tlog
         self.ratekeeper = ratekeeper
+        self.generation = generation
         self.commit_stream: PromiseStream[CommitTransactionRequest] = PromiseStream()
         self.grv_stream: PromiseStream[GetReadVersionRequest] = PromiseStream()
         self._tasks = ActorCollection()
@@ -166,15 +167,31 @@ class CommitProxy:
             # resolve_batch's own failure path) and the tlog's, via an
             # empty batch for this window (tlog.commit is idempotent per
             # window, so a failure after logging is safe too).
-            from ..core.errors import OperationFailed
-
-            TraceEvent("ProxyCommitBatchError", severity=40).error(e).log()
-            await self.resolver.skip_window(prev_version, version)
-            await self.tlog.commit(prev_version, version, [])
-            self.master.report_committed(version)
+            # An epoch fence is EXPECTED during recovery (severity 30);
+            # anything else is a real failure (severity 40).
+            fenced = isinstance(e, TLogStopped)
+            TraceEvent("ProxyCommitBatchError",
+                       severity=30 if fenced else 40).error(e).log()
+            try:
+                await self.resolver.skip_window(prev_version, version)
+                await self.tlog.commit(prev_version, version, [],
+                                       epoch=self.generation)
+                self.master.report_committed(version)
+            except TLogStopped:
+                # The tlog is locked by a newer generation: this proxy is
+                # dead and recovery owns the chains now. Any OTHER failure
+                # propagates loudly (a wedged chain must never be silent —
+                # and the controller's commit-path health probe detects it).
+                pass
+            # A commit refused by an epoch-locked tlog definitively did NOT
+            # happen: clients get the retryable not_committed and their
+            # retry lands on the new generation (ref: recovery aborting
+            # in-flight commits).
+            err = (NotCommitted("transaction system recovered")
+                   if fenced else OperationFailed(str(e)))
             for r in reqs:
                 if not r.reply.is_set():
-                    r.reply.send_error(OperationFailed(str(e)))
+                    r.reply.send_error(err)
 
     async def _commit_batch_impl(
         self, reqs: list[CommitTransactionRequest], prev_version: int,
@@ -213,7 +230,8 @@ class CommitProxy:
             await loop.delay(0.05 * loop.random.random01())
 
         # Phase 4: make the batch durable in version order.
-        await self.tlog.commit(prev_version, version, mutations)
+        await self.tlog.commit(prev_version, version, mutations,
+                               epoch=self.generation)
 
         # Phase 5: advance committed version, answer clients.
         self.master.report_committed(version)

@@ -1,0 +1,259 @@
+"""Recovery generations: a coordinated, fenced rebuild of the transaction
+system over the surviving log (ref: fdbserver/masterserver.actor.cpp
+masterCore :1077 / recoverFrom :705; ClusterController's
+clusterWatchDatabase :985 recruits a new master when the old one dies).
+
+The recovery sequence, exactly the reference's shape:
+
+  1. A controller holding the coordination lease bumps the generation in
+     the coordinated state (the fence: older generations can no longer
+     write it).
+  2. Epoch end: lock the log at the new generation
+     (TagPartitionedLogSystem::epochEnd) — in-flight commits from the old
+     generation now fail, and the durable version becomes the RECOVERY
+     VERSION: everything at or below it is kept, everything above never
+     happened.
+  3. Recruit fresh stateless roles: a new master (version authority
+     starting at the recovery version), a new resolver whose conflict
+     history is re-seeded AT the recovery version (any transaction with an
+     older snapshot conflicts — the reference initializes recovered
+     resolvers the same way), and a new proxy tagged with the generation.
+  4. Publish the new endpoints; clients' retry loops (timeouts +
+     commit_unknown_result) land on the new generation transparently.
+
+Storage and the log survive role death here (the common FDB failure mode:
+stateless roles die, tlogs' durable state persists); full log-server loss
+is the domain of log replication, a later tier.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ..core.actors import ActorCollection
+from ..core.errors import OperationFailed
+from ..core.knobs import SERVER_KNOBS
+from ..core.runtime import TaskPriority, current_loop, spawn
+from ..core.trace import TraceEvent
+from ..resolver.cpu import ConflictSetCPU
+from .coordination import CoordinatedState, CoordinatorRegister, LeaderElection
+from .master import Master
+from .proxy import CommitProxy
+from .ratekeeper import Ratekeeper
+from .resolver_role import ResolverRole
+from .storage import StorageServer
+from .tlog import MemoryTLog
+
+
+class EndpointRef:
+    """Indirection clients hold instead of a concrete stream: recovery
+    repoints it at the new generation's endpoint (ref: MonitorLeader's
+    re-resolution of cluster interfaces)."""
+
+    def __init__(self, target=None):
+        self.target = target
+
+    def send(self, req) -> None:
+        if self.target is not None:
+            self.target.send(req)
+        # No target (mid-recovery): the message is dropped; the client's
+        # timeout/retry machinery handles it like any lost request.
+
+
+class RecoverableCluster:
+    """A cluster whose transaction system can die and be re-recruited.
+
+    The storage node and the log are long-lived; master/proxy/resolver/
+    ratekeeper are per-generation. `database()` hands out connections bound
+    to EndpointRefs, so clients transparently follow recoveries.
+    """
+
+    def __init__(
+        self,
+        conflict_set_factory: Optional[Callable[[int], object]] = None,
+        n_coordinators: int = 3,
+    ):
+        self.conflict_set_factory = conflict_set_factory or (
+            lambda v: ConflictSetCPU(v)
+        )
+        self.coordinators = [
+            CoordinatorRegister(f"coord{i}") for i in range(n_coordinators)
+        ]
+        self.cstate = CoordinatedState(self.coordinators, key="generation")
+        self.election = LeaderElection(
+            CoordinatedState(self.coordinators, key="leader"),
+            lease_seconds=1.0,
+        )
+        self.tlog = MemoryTLog(0)
+        self.storage = StorageServer(self.tlog, 0)
+        self.generation = 0
+        self.recoveries_done = 0
+        self.master: Optional[Master] = None
+        self.resolver: Optional[ResolverRole] = None
+        self.proxy: Optional[CommitProxy] = None
+        self.ratekeeper: Optional[Ratekeeper] = None
+        self.grv_ref = EndpointRef()
+        self.commit_ref = EndpointRef()
+        self.storage_ref = EndpointRef()
+        self._controllers = ActorCollection()
+
+    # -- lifecycle --
+    def start(self) -> "RecoverableCluster":
+        self.storage.start()
+        self.storage_ref.target = self.storage.read_stream
+        self._recover()
+        return self
+
+    def stop(self) -> None:
+        self._controllers.cancel_all()
+        self._stop_transaction_system()
+        self.storage.stop()
+
+    def database(self):
+        from ..client.connection import ClusterConnection
+        from ..client.database import Database
+
+        conn = ClusterConnection(self.grv_ref, self.commit_ref,
+                                 self.storage_ref)
+        return Database(self, conn=conn)
+
+    # -- failure injection (tests / attrition) --
+    def kill_transaction_system(self) -> None:
+        """Drop master/proxy/resolver on the floor (role death with state
+        loss — their state is per-generation by design)."""
+        TraceEvent("TxnSystemKilled", severity=30).detail(
+            "Generation", self.generation
+        ).log()
+        self._stop_transaction_system()
+
+    def _stop_transaction_system(self) -> None:
+        if self.proxy is not None:
+            self.proxy.stop()
+        if self.ratekeeper is not None:
+            self.ratekeeper.stop()
+        self.grv_ref.target = None
+        self.commit_ref.target = None
+        self.master = None
+        self.resolver = None
+        self.proxy = None
+        self.ratekeeper = None
+
+    # -- recovery --
+    def _recover(self) -> None:
+        """Steps 1-4 of the module docstring. Synchronous: every step is
+        quorum arithmetic + object construction on the loop thread."""
+
+        def bump(cur):
+            gen = (cur or {"generation": 0})["generation"] + 1
+            return {"generation": gen, "recovery_version": None}
+
+        _, st = self.cstate.read_modify_write(bump)
+        generation = st["generation"]
+        recovery_version = self.tlog.lock(generation)
+        # The new generation's version chain must start above anything the
+        # old generation ever RECEIVED at the log (purged non-durable
+        # entries leave a skipped version gap; storage follows entries, not
+        # the counter).
+        start_version = max(recovery_version, self.tlog.version.get())
+
+        self._stop_transaction_system()
+        self.generation = generation
+        self.master = Master(init_version=start_version)
+        # Resolver history re-seeds AT the recovery point: any transaction
+        # whose snapshot predates it conflicts and retries on the new
+        # generation (ref: sendInitialCommitToResolvers' fresh state).
+        self.resolver = ResolverRole(
+            self.conflict_set_factory(start_version),
+            init_version=start_version,
+        )
+        self.ratekeeper = Ratekeeper(self.tlog, self.storage)
+        self.proxy = CommitProxy(
+            self.master, self.resolver, self.tlog,
+            ratekeeper=self.ratekeeper, generation=generation,
+        )
+        self.ratekeeper.start()
+        self.proxy.start()
+        self.grv_ref.target = self.proxy.grv_stream
+        self.commit_ref.target = self.proxy.commit_stream
+
+        # The recovery transaction (ref: masterserver.actor.cpp:124 / the
+        # recovery commit): an empty commit through the new proxy drives
+        # the first version of the new generation through the log so
+        # storage and GRVs converge even before any client acts.
+        from .interfaces import CommitTransactionRequest
+
+        rec_txn = CommitTransactionRequest(
+            read_snapshot=start_version, read_conflict_ranges=(),
+            write_conflict_ranges=(), mutations=(),
+        )
+        self.commit_ref.send(rec_txn)
+
+        def seal(cur):
+            if cur is None or cur["generation"] != generation:
+                return cur  # fenced by an even newer generation
+            return {"generation": generation,
+                    "recovery_version": recovery_version}
+
+        self.cstate.read_modify_write(seal)
+        self.recoveries_done += 1
+        TraceEvent("RecoveryComplete").detail("Generation", generation).detail(
+            "RecoveryVersion", recovery_version
+        ).log()
+
+    # -- the controller role (ref: clusterWatchDatabase + failure pings) --
+    def start_controller(self, name: str = "cc0") -> None:
+        """Spawn a controller candidate: campaigns for the coordination
+        lease, and while leading, health-checks the transaction system and
+        recovers it on failure. Multiple candidates may run; the lease
+        arbitrates (ref: ClusterController election + WaitFailure)."""
+
+        async def controller():
+            loop = current_loop()
+            lease = None
+            while True:
+                await loop.delay(
+                    SERVER_KNOBS.RATEKEEPER_UPDATE_INTERVAL
+                    * (0.8 + 0.4 * loop.random.random01())
+                )
+                if lease is None:
+                    lease = self.election.try_become_leader(name)
+                    continue
+                renewed = self.election.heartbeat(lease)
+                if renewed is None:
+                    TraceEvent("ControllerDeposed").detail("Name", name).log()
+                    lease = None
+                    continue
+                lease = renewed
+                if not await self._txn_system_healthy():
+                    TraceEvent("ControllerRecovering", severity=30).detail(
+                        "Name", name
+                    ).detail("Generation", self.generation).log()
+                    try:
+                        self._recover()
+                    except OperationFailed as e:
+                        TraceEvent("RecoveryFailed", severity=40).error(e).log()
+
+        self._controllers.add(
+            spawn(controller(), TaskPriority.COORDINATION,
+                  name=f"controller:{name}")
+        )
+
+    async def _txn_system_healthy(self) -> bool:
+        """A real end-to-end probe through the COMMIT path: an empty commit
+        must answer within the failure timeout. GRV alone cannot see a
+        wedged version chain (the GRV batcher keeps answering while every
+        commit blocks in when_at_least), so the probe exercises master ->
+        resolver -> tlog exactly like client traffic (ref: WaitFailure's
+        per-role ping + the latency probe in Status)."""
+        from ..core.actors import timeout
+        from .interfaces import CommitTransactionRequest
+
+        if self.proxy is None:
+            return False
+        probe = CommitTransactionRequest(
+            read_snapshot=0, read_conflict_ranges=(),
+            write_conflict_ranges=(), mutations=(),
+        )
+        self.commit_ref.send(probe)
+        got = await timeout(probe.reply.future, 0.6, default=None)
+        return got is not None

@@ -14,6 +14,7 @@ the storage engine work (SURVEY §7 step 4) without changing this interface.
 from __future__ import annotations
 
 from ..core.actors import NotifiedVersion
+from ..core.errors import TLogStopped
 from ..core.runtime import buggify, current_loop
 from ..core.trace import TraceEvent
 
@@ -24,11 +25,40 @@ class MemoryTLog:
         self.version = NotifiedVersion(init_version)   # highest received
         self.durable = NotifiedVersion(init_version)   # highest "fsynced"
         self.popped = init_version
+        self.locked_epoch = 0
 
-    async def commit(self, prev_version: int, version: int, mutations: list):
+    def lock(self, epoch: int) -> int:
+        """Epoch end (ref: TagPartitionedLogSystem::epochEnd :107): fence
+        out every older generation — their in-flight commits will fail —
+        and return the durable version the new generation recovers from.
+        Entries received but never durable are PURGED: they belong to
+        commits that never completed and must never become visible (their
+        versions are simply skipped; storage follows the entry stream)."""
+        assert epoch >= self.locked_epoch, "lock() by an older generation"
+        self.locked_epoch = epoch
+        d = self.durable.get()
+        self._entries = [e for e in self._entries if e[0] <= d]
+        # Advance the durability cursor over the purged gap so the new
+        # generation's chain (which must start above every RECEIVED
+        # version) can make progress; the gap holds no entries, so nothing
+        # un-durable is ever exposed. Old-generation commits woken by this
+        # advance re-check the epoch below and fail.
+        self.durable.set(self.version.get())
+        TraceEvent("TLogLocked").detail("Epoch", epoch).detail(
+            "RecoveryVersion", d
+        ).detail("ReceivedVersion", self.version.get()).log()
+        return d
+
+    async def commit(self, prev_version: int, version: int, mutations: list,
+                     epoch: int = 0):
         """Append one batch's mutations; resolves when durable (ref:
-        tLogCommit waits version order then fsyncs via DiskQueue)."""
+        tLogCommit waits version order then fsyncs via DiskQueue). A commit
+        from a generation older than the lock epoch is refused."""
+        if epoch < self.locked_epoch:
+            raise TLogStopped(f"locked by generation {self.locked_epoch}")
         await self.version.when_at_least(prev_version)
+        if epoch < self.locked_epoch:  # re-check: lock may land mid-wait
+            raise TLogStopped(f"locked by generation {self.locked_epoch}")
         if self.version.get() == prev_version:
             # Sole appender for this version window. Empty batches are
             # logged too: version advances must reach storage servers or a
@@ -40,10 +70,17 @@ class MemoryTLog:
         if buggify("tlog_slow_fsync"):
             await current_loop().delay(0.1 * current_loop().random.random01())
         await self.durable.when_at_least(prev_version)
+        if epoch < self.locked_epoch:
+            raise TLogStopped(f"locked by generation {self.locked_epoch}")
         if self.durable.get() == prev_version:
             self.durable.set(version)
             TraceEvent("TLogCommitDurable").detail("Version", version).log()
         await self.durable.when_at_least(version)
+        # Final fence: a lock() that purged this batch also advanced the
+        # durability cursor past it, waking this waiter — it must fail, not
+        # report a never-durable commit as committed.
+        if epoch < self.locked_epoch:
+            raise TLogStopped(f"locked by generation {self.locked_epoch}")
 
     async def peek(self, from_version: int) -> list[tuple[int, list]]:
         """All DURABLE entries with version > from_version; awaits until at

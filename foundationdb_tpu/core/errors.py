@@ -69,6 +69,7 @@ MasterRecoveryFailed = _define("MasterRecoveryFailed", 1203, "Master recovery fa
 WorkerRemoved = _define("WorkerRemoved", 1202, "Normal worker shut down")
 PlatformError = _define("PlatformError", 1500, "Platform error")
 IoError = _define("IoError", 1510, "Disk i/o operation failed")
+TLogStopped = _define("TLogStopped", 1011, "TLog stopped (locked by a newer recovery generation)")
 EndOfStream = _define("EndOfStream", 1, "End of stream")
 
 RETRYABLE_CODES = frozenset(
