@@ -1,0 +1,149 @@
+"""Backup/restore: consistent range snapshots to a file container (ref:
+fdbclient/FileBackupAgent.actor.cpp + BackupContainer.actor.cpp; design/
+backup.md — range snapshots plus mutation logs).
+
+This is the snapshot half of the reference's scheme: the whole keyspace
+(or a range) is read in chunks AT ONE READ VERSION — MVCC makes the
+snapshot transactionally consistent without blocking writers — and written
+to a length-prefixed container file with the snapshot version in the
+header. Restore clears the target range and writes the rows back in
+chunked transactions. The continuous mutation-log half (point-in-time
+restore between snapshots) layers on the same container format later.
+
+The snapshot must finish within the MVCC read window (5s of versions) —
+the same constraint the reference handles by splitting snapshots into
+many short range tasks (TaskBucket); chunking here keeps each read short,
+and a too-slow snapshot surfaces as transaction_too_old, never as a torn
+backup.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+
+from .client.database import Database
+from .core.trace import TraceEvent
+
+MAGIC = b"FDBTPUB1"
+_LEN = struct.Struct("<I")
+# System-space key marking a restore in progress (ref: the reference's
+# restore lock in `\xff` — fdbclient/SystemData restore keys).
+RESTORE_MARKER = b"\xff/restoreInProgress"
+
+
+def _write_rec(f, key: bytes, value: bytes) -> None:
+    f.write(_LEN.pack(len(key)) + key + _LEN.pack(len(value)) + value)
+
+
+def _read_recs(f):
+    while True:
+        raw = f.read(_LEN.size)
+        if not raw:
+            return
+        (klen,) = _LEN.unpack(raw)
+        key = f.read(klen)
+        (vlen,) = _LEN.unpack(f.read(_LEN.size))
+        value = f.read(vlen)
+        yield key, value
+
+
+async def backup(
+    db: Database,
+    path: str,
+    begin: bytes = b"",
+    end: bytes = b"\xff",
+    chunk_rows: int = 1000,
+) -> int:
+    """Snapshot [begin, end) to `path`; returns the snapshot version."""
+    from .kv.keys import key_after
+
+    tr = db.create_transaction()
+    version = await tr.get_read_version()
+    rows = 0
+    tmp = path + ".part"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(MAGIC + struct.pack("<q", version))
+            cursor = begin
+            while True:
+                chunk = await tr.get_range(
+                    cursor, end, limit=chunk_rows, snapshot=True
+                )
+                for k, v in chunk:
+                    _write_rec(f, k, v)
+                    rows += 1
+                if len(chunk) < chunk_rows:
+                    break
+                cursor = key_after(chunk[-1][0])
+            f.flush()
+            os.fsync(f.fileno())
+    except BaseException:
+        # A failed snapshot (e.g. transaction_too_old past the MVCC
+        # window) must not leave partial containers behind.
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    os.replace(tmp, path)  # atomic publish: a backup file is always complete
+    TraceEvent("BackupComplete").detail("Path", path).detail(
+        "Version", version
+    ).detail("Rows", rows).log()
+    return version
+
+
+async def restore(
+    db: Database,
+    path: str,
+    begin: bytes = b"",
+    end: bytes = b"\xff",
+    chunk_rows: int = 500,
+) -> int:
+    """Replace [begin, end) with the backup's contents; returns the row
+    count (ref: restore applies range files then replays logs — only the
+    range half exists here).
+
+    NOT atomic: the clear and the chunked writes are separate transactions
+    (a snapshot can exceed the one-transaction size limit). As in the
+    reference, the range is marked being-restored for the duration
+    (RESTORE_MARKER in the `\\xff` system space): a crashed restore is
+    detectable by the marker and must be re-run to completion, and writers
+    of the range should be quiesced while it is set."""
+    total = 0
+    marker = RESTORE_MARKER
+
+    async def begin_body(tr):
+        tr.set(marker, path.encode())
+        tr.clear_range(begin, end)
+
+    with open(path, "rb") as f:
+        header = f.read(len(MAGIC) + 8)
+        if header[: len(MAGIC)] != MAGIC:
+            raise ValueError(f"{path} is not a backup container")
+        await db.transact(begin_body)
+        recs = _read_recs(f)
+        while True:
+            chunk = []
+            for rec in recs:
+                chunk.append(rec)
+                if len(chunk) >= chunk_rows:
+                    break
+            if not chunk:
+                break
+
+            async def write_body(tr, chunk=chunk):
+                for k, v in chunk:
+                    tr.set(k, v)
+
+            await db.transact(write_body)
+            total += len(chunk)
+
+    async def finish_body(tr):
+        tr.clear(marker)
+
+    await db.transact(finish_body)
+    TraceEvent("RestoreComplete").detail("Path", path).detail(
+        "Rows", total
+    ).log()
+    return total

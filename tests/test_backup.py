@@ -1,0 +1,83 @@
+"""Backup/restore: transactionally consistent snapshots survive concurrent
+writers; restore reproduces the snapshot exactly."""
+
+from foundationdb_tpu.backup import backup, restore
+from foundationdb_tpu.cluster import LocalCluster
+from foundationdb_tpu.core.runtime import loop_context, sim_loop, spawn
+
+
+def test_backup_restore_roundtrip(tmp_path):
+    path = str(tmp_path / "snap.fdbb")
+    loop = sim_loop(seed=1)
+    with loop_context(loop):
+        cluster = LocalCluster().start()
+        db = cluster.database()
+
+        async def main():
+            async def fill(tr):
+                for i in range(500):
+                    tr.set(b"k%04d" % i, b"v%d" % i)
+
+            await db.transact(fill)
+            v = await backup(db, path, chunk_rows=64)
+            assert v > 0
+            # Mutate after the snapshot...
+            await db.set(b"k0001", b"CHANGED")
+            await db.clear(b"k0002")
+            await db.set(b"new", b"row")
+            # ...then restore: the snapshot state comes back exactly.
+            n = await restore(db, path, chunk_rows=100)
+            assert n == 500
+            rows = await db.transact(lambda tr: tr.get_range(b"", b"\xff"))
+            cluster.stop()
+            return rows
+
+        rows = loop.run(main(), timeout_sim_seconds=1e6)
+    assert len(rows) == 500
+    assert (b"k0001", b"v1") in rows and (b"k0002", b"v2") in rows
+    assert all(k != b"new" for k, _ in rows)
+
+
+def test_backup_is_consistent_under_concurrent_writes(tmp_path):
+    """A writer hammers one pair of keys kept equal by every transaction;
+    the snapshot (taken mid-stream at one read version) must never contain
+    a torn pair."""
+    path = str(tmp_path / "snap.fdbb")
+    loop = sim_loop(seed=2)
+    with loop_context(loop):
+        cluster = LocalCluster().start()
+        db = cluster.database()
+
+        async def main():
+            async def init(tr):
+                tr.set(b"pair/a", b"0")
+                tr.set(b"pair/b", b"0")
+
+            await db.transact(init)
+
+            stop = [False]
+
+            async def writer():
+                i = 0
+                while not stop[0]:
+                    i += 1
+
+                    async def bump(tr, i=i):
+                        tr.set(b"pair/a", b"%d" % i)
+                        tr.set(b"pair/b", b"%d" % i)
+
+                    await db.transact(bump)
+
+            w = spawn(writer(), name="writer")
+            await backup(db, path, chunk_rows=1)  # tiny chunks: many reads
+            stop[0] = True
+            await w.done
+            n = await restore(db, path)
+            rows = dict(await db.transact(
+                lambda tr: tr.get_range(b"pair/", b"pair0")
+            ))
+            cluster.stop()
+            return rows
+
+        rows = loop.run(main(), timeout_sim_seconds=1e6)
+    assert rows[b"pair/a"] == rows[b"pair/b"], "torn snapshot"
