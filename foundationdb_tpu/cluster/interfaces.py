@@ -88,6 +88,17 @@ class WatchValueRequest:
 
 
 @dataclass
+class TLogCommitRequest:
+    """(ref: TLogCommitRequest, fdbserver/TLogInterface.h)."""
+
+    prev_version: int
+    version: int
+    mutations: Sequence[Mutation]
+    epoch: int = 0
+    reply: Promise = field(default_factory=Promise)
+
+
+@dataclass
 class ResolveTransactionBatchRequest:
     """(ref: ResolveTransactionBatchRequest, ResolverInterface.h:70)."""
 

@@ -36,6 +36,7 @@ from .interfaces import (
     GetReadVersionRequest,
     Mutation,
     ResolveTransactionBatchRequest,
+    TLogCommitRequest,
 )
 from .master import Master
 from .resolver_role import ResolverRole
@@ -53,12 +54,18 @@ def mutation_write_ranges(m: Mutation) -> KeyRange:
 
 class CommitProxy:
     def __init__(self, master: Master, resolver: ResolverRole, tlog: MemoryTLog,
-                 ratekeeper=None, generation: int = 0):
+                 ratekeeper=None, generation: int = 0,
+                 resolver_endpoint=None, tlog_endpoint=None):
         self.master = master
         self.resolver = resolver
         self.tlog = tlog
         self.ratekeeper = ratekeeper
         self.generation = generation
+        # When set, the resolver/log hops go through request endpoints
+        # (possibly across a simulated network) instead of direct calls —
+        # the role code is identical either way, as with FlowTransport.
+        self.resolver_endpoint = resolver_endpoint
+        self.tlog_endpoint = tlog_endpoint
         self.commit_stream: PromiseStream[CommitTransactionRequest] = PromiseStream()
         self.grv_stream: PromiseStream[GetReadVersionRequest] = PromiseStream()
         self._tasks = ActorCollection()
@@ -167,15 +174,19 @@ class CommitProxy:
             # resolve_batch's own failure path) and the tlog's, via an
             # empty batch for this window (tlog.commit is idempotent per
             # window, so a failure after logging is safe too).
-            # An epoch fence is EXPECTED during recovery (severity 30);
-            # anything else is a real failure (severity 40).
+            from ..core.errors import CommitUnknownResult, RequestMaybeDelivered
+
+            # An epoch fence is EXPECTED during recovery, and a lost role
+            # RPC is environmental (severity 30); anything else is a real
+            # failure (severity 40).
             fenced = isinstance(e, TLogStopped)
+            lost_rpc = isinstance(e, RequestMaybeDelivered)
             TraceEvent("ProxyCommitBatchError",
-                       severity=30 if fenced else 40).error(e).log()
+                       severity=30 if (fenced or lost_rpc) else 40
+                       ).error(e).log()
             try:
                 await self.resolver.skip_window(prev_version, version)
-                await self.tlog.commit(prev_version, version, [],
-                                       epoch=self.generation)
+                await self._tlog_commit(prev_version, version, [])
                 self.master.report_committed(version)
             except TLogStopped:
                 # The tlog is locked by a newer generation: this proxy is
@@ -183,15 +194,51 @@ class CommitProxy:
                 # propagates loudly (a wedged chain must never be silent —
                 # and the controller's commit-path health probe detects it).
                 pass
-            # A commit refused by an epoch-locked tlog definitively did NOT
-            # happen: clients get the retryable not_committed and their
-            # retry lands on the new generation (ref: recovery aborting
-            # in-flight commits).
-            err = (NotCommitted("transaction system recovered")
-                   if fenced else OperationFailed(str(e)))
+            # Error mapping for clients: an epoch-locked tlog refusal
+            # definitively did NOT commit (retryable not_committed, the
+            # retry lands on the new generation); a lost role RPC is
+            # genuinely ambiguous — the detached request may still land
+            # after the compensation, in which case the tlog's sole-
+            # appender-per-window rule keeps exactly one outcome — so
+            # clients get commit_unknown_result and their dedup-pattern
+            # retries stay correct. Everything else is a hard failure.
+            if fenced:
+                err = NotCommitted("transaction system recovered")
+            elif lost_rpc:
+                err = CommitUnknownResult(str(e))
+            else:
+                err = OperationFailed(str(e))
             for r in reqs:
                 if not r.reply.is_set():
                     r.reply.send_error(err)
+
+    async def _call_endpoint(self, endpoint, req):
+        """One role-to-role RPC with a deadline: a reply that never comes
+        (dropped message over a failed link) must fail the batch as
+        maybe-committed rather than wedge the pipeline forever — the
+        FailureMonitor-shaped contract of the reference's loadBalance."""
+        from ..core.actors import timeout
+        from ..core.errors import RequestMaybeDelivered
+
+        endpoint.send(req)
+        lost = object()
+        result = await timeout(
+            req.reply.future, SERVER_KNOBS.ROLE_RPC_TIMEOUT, lost
+        )
+        if result is lost:
+            raise RequestMaybeDelivered(
+                f"{type(req).__name__} reply not received"
+            )
+        return result
+
+    async def _tlog_commit(self, prev_version, version, mutations):
+        if self.tlog_endpoint is not None:
+            req = TLogCommitRequest(prev_version, version, tuple(mutations),
+                                    epoch=self.generation)
+            await self._call_endpoint(self.tlog_endpoint, req)
+        else:
+            await self.tlog.commit(prev_version, version, mutations,
+                                   epoch=self.generation)
 
     async def _commit_batch_impl(
         self, reqs: list[CommitTransactionRequest], prev_version: int,
@@ -212,14 +259,18 @@ class CommitProxy:
             )
             for r in reqs
         ]
-        result = await self.resolver.resolve_batch(
-            ResolveTransactionBatchRequest(
-                prev_version=prev_version,
-                version=version,
-                last_receive_version=prev_version,
-                transactions=txns,
-            )
+        resolve_req = ResolveTransactionBatchRequest(
+            prev_version=prev_version,
+            version=version,
+            last_receive_version=prev_version,
+            transactions=txns,
         )
+        if self.resolver_endpoint is not None:
+            result = await self._call_endpoint(
+                self.resolver_endpoint, resolve_req
+            )
+        else:
+            result = await self.resolver.resolve_batch(resolve_req)
 
         # Phase 3: merge verdicts, build the log payload.
         mutations = []
@@ -230,8 +281,7 @@ class CommitProxy:
             await loop.delay(0.05 * loop.random.random01())
 
         # Phase 4: make the batch durable in version order.
-        await self.tlog.commit(prev_version, version, mutations,
-                               epoch=self.generation)
+        await self._tlog_commit(prev_version, version, mutations)
 
         # Phase 5: advance committed version, answer clients.
         self.master.report_committed(version)

@@ -13,6 +13,7 @@ window is MAX_WRITE_TRANSACTION_LIFE_VERSIONS behind the batch version
 from __future__ import annotations
 
 from ..core.actors import NotifiedVersion
+from ..core.errors import OperationFailed
 from ..core.knobs import SERVER_KNOBS
 from ..core.trace import TraceEvent
 from ..resolver.types import ConflictBatchResult
@@ -20,6 +21,17 @@ from .interfaces import ResolveTransactionBatchRequest
 
 
 class ResolverRole:
+    def start_serving(self):
+        """Serve ResolveTransactionBatchRequests from self.resolve_stream,
+        so the proxy->resolver hop can cross a (simulated) network exactly
+        like the reference's RPC (ResolverInterface.resolve RequestStream).
+        Returns the serving task."""
+        from ..core.actors import serve_requests
+        from ..core.runtime import TaskPriority
+
+        return serve_requests(self.resolve_stream, self.resolve_batch,
+                              TaskPriority.RESOLVER, "resolverServe")
+
     async def skip_window(self, prev_version: int, version: int) -> None:
         """Advance the version chain over a window that resolved nothing
         (a proxy batch that failed before reaching this resolver). No-op
@@ -29,7 +41,10 @@ class ResolverRole:
             self.version.set(version)
 
     def __init__(self, conflict_set, init_version: int = 0):
+        from ..core.actors import PromiseStream
+
         self.cs = conflict_set
+        self.resolve_stream = PromiseStream()
         self.version = NotifiedVersion(init_version)
         # Counters (ref: Resolver.actor.cpp:155-158 g_counters).
         self.conflict_batches = 0
@@ -40,12 +55,17 @@ class ResolverRole:
         self, req: ResolveTransactionBatchRequest
     ) -> ConflictBatchResult:
         await self.version.when_at_least(req.prev_version)
-        # Duplicate/replayed batches would re-merge writes; the reference
-        # keeps recent outputs and replays them (:97-104). In-process the
-        # proxy never re-sends, so assert the happy path instead.
-        assert self.version.get() == req.prev_version, (
-            "resolver received overlapping batch windows"
-        )
+        if self.version.get() != req.prev_version:
+            # This window was already driven past — e.g. the proxy timed
+            # the request out over a slow link and compensated with
+            # skip_window, or a newer generation recovered. Re-resolving
+            # would re-merge writes; refuse instead (the reference keeps
+            # recent outputs and replays them, :97-104 — here the caller
+            # that compensated has already answered its clients).
+            raise OperationFailed(
+                f"resolver window ({req.prev_version}, {req.version}] "
+                f"already superseded at version {self.version.get()}"
+            )
         new_oldest = max(
             0, req.version - SERVER_KNOBS.MAX_WRITE_TRANSACTION_LIFE_VERSIONS
         )

@@ -36,11 +36,13 @@ class StorageServer:
         self._tasks = []
 
     def start(self) -> None:
+        from ..core.actors import serve_requests
+
         self._tasks = [
             spawn(self._update_loop(), TaskPriority.STORAGE,
                   name="storage_update"),
-            spawn(self._serve_loop(), TaskPriority.STORAGE,
-                  name="storage_serve"),
+            serve_requests(self.read_stream, self._serve_one,
+                           TaskPriority.STORAGE, "storage_serve"),
         ]
 
     def stop(self) -> None:
@@ -49,29 +51,16 @@ class StorageServer:
 
     # -- request serving: each request answered via its reply promise so the
     #    endpoint works identically in-process and across the sim network --
-    async def _serve_loop(self):
-        while True:
-            req = await self.read_stream.pop()
-            spawn(self._serve_one(req), TaskPriority.STORAGE,
-                  name="storage_req")
-
     async def _serve_one(self, req):
-        try:
-            if isinstance(req, GetValueRequest):
-                result = await self.get_value(req)
-            elif isinstance(req, GetRangeRequest):
-                result = await self.get_range(req)
-            elif isinstance(req, WatchValueRequest):
-                # watch_value resolves req.reply itself on change.
-                await self.watch_value(req)
-                return
-            else:
-                raise TypeError(f"unknown storage request {type(req)}")
-            if not req.reply.is_set():
-                req.reply.send(result)
-        except BaseException as e:  # noqa: BLE001 — errors go to the caller
-            if not req.reply.is_set():
-                req.reply.send_error(e)
+        if isinstance(req, GetValueRequest):
+            return await self.get_value(req)
+        if isinstance(req, GetRangeRequest):
+            return await self.get_range(req)
+        if isinstance(req, WatchValueRequest):
+            # watch_value resolves req.reply itself on change; returning
+            # its result is harmless (reply already set).
+            return await self.watch_value(req)
+        raise TypeError(f"unknown storage request {type(req)}")
 
     # -- ingest (ref: update :2321) --
     async def _update_loop(self):

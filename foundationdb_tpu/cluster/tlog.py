@@ -13,7 +13,7 @@ the storage engine work (SURVEY §7 step 4) without changing this interface.
 
 from __future__ import annotations
 
-from ..core.actors import NotifiedVersion
+from ..core.actors import NotifiedVersion, PromiseStream, serve_requests
 from ..core.errors import TLogStopped
 from ..core.runtime import buggify, current_loop
 from ..core.trace import TraceEvent
@@ -21,6 +21,7 @@ from ..core.trace import TraceEvent
 
 class MemoryTLog:
     def __init__(self, init_version: int = 0):
+        self.commit_stream: PromiseStream = PromiseStream()
         self._entries: list[tuple[int, list]] = []  # (version, mutations)
         self.version = NotifiedVersion(init_version)   # highest received
         self.durable = NotifiedVersion(init_version)   # highest "fsynced"
@@ -96,6 +97,21 @@ class MemoryTLog:
             await self.durable.when_at_least(
                 max(d, from_version) + 1
             )
+
+    def start_serving(self):
+        """Serve TLogCommitRequests from self.commit_stream so the
+        proxy->log hop can cross a (simulated) network like the reference's
+        RPC (TLogInterface.commit RequestStream). The reply resolves once
+        the batch is durable; fence errors propagate to the caller."""
+        from ..core.runtime import TaskPriority
+
+        async def handle(req):
+            await self.commit(req.prev_version, req.version, req.mutations,
+                              epoch=req.epoch)
+            return None
+
+        return serve_requests(self.commit_stream, handle,
+                              TaskPriority.TLOG_COMMIT, "tlogServe")
 
     def pop(self, upto_version: int) -> None:
         """Storage acknowledges durability through upto_version; the log can

@@ -265,6 +265,31 @@ class ActorCollection:
         self.tasks = []
 
 
+def serve_requests(stream: "PromiseStream", handler, priority: int,
+                   name: str) -> Task:
+    """Spawn a request-serving loop: pop requests forever, handle each in
+    its own task, and answer via the request's reply promise (errors
+    included) — the standard endpoint shape every role uses (ref: the
+    RequestStream serve loops in each *Interface)."""
+    from .runtime import spawn
+
+    async def serve_one(req):
+        try:
+            result = await handler(req)
+            if not req.reply.is_set():
+                req.reply.send(result)
+        except BaseException as e:  # noqa: BLE001 — errors go to the caller
+            if not req.reply.is_set():
+                req.reply.send_error(e)
+
+    async def serve():
+        while True:
+            req = await stream.pop()
+            spawn(serve_one(req), priority, name=f"{name}_req")
+
+    return spawn(serve(), priority, name=name)
+
+
 async def recurring(fn, interval: float, priority: int = TaskPriority.DEFAULT):
     """Calls fn() every `interval` seconds forever (ref: recurring)."""
     loop = current_loop()

@@ -87,6 +87,9 @@ class ServerKnobs(Knobs):
         init("CANDIDATE_MAX_DELAY", 1.0)
         init("POLLING_FREQUENCY", 1.0)
         init("HEARTBEAT_FREQUENCY", 0.5)
+        # Server-side role-to-role RPC deadline: a lost resolver/log hop
+        # fails its batch as maybe-committed instead of wedging forever.
+        init("ROLE_RPC_TIMEOUT", 5.0)
 
 
 class ClientKnobs(Knobs):

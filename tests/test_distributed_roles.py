@@ -1,0 +1,147 @@
+"""The transaction pipeline with its ROLE-TO-ROLE hops over the simulated
+network: client, proxy, resolver, and log/storage each on their own
+simulated process, with latency and clogs between them (ref: the data
+plane client -> proxy -> resolver -> tlog -> storage crossing process
+boundaries, SURVEY §3.2; transport seam = fdbrpc/FlowTransport)."""
+
+from foundationdb_tpu.client.connection import ClusterConnection
+from foundationdb_tpu.client.database import Database
+from foundationdb_tpu.cluster.cluster import LocalCluster
+from foundationdb_tpu.cluster.master import Master
+from foundationdb_tpu.cluster.proxy import CommitProxy
+from foundationdb_tpu.cluster.resolver_role import ResolverRole
+from foundationdb_tpu.cluster.storage import StorageServer
+from foundationdb_tpu.cluster.tlog import MemoryTLog
+from foundationdb_tpu.core.runtime import current_loop, loop_context, sim_loop
+from foundationdb_tpu.resolver.cpu import ConflictSetCPU
+from foundationdb_tpu.sim.network import RemoteStream, SimNetwork, SimProcess
+from foundationdb_tpu.workloads.cycle import CycleWorkload
+
+
+class RoleDistributedCluster:
+    """Every role on its own SimProcess; every hop a RemoteStream."""
+
+    def __init__(self):
+        self.net = SimNetwork()
+        self.p_client = SimProcess("client")
+        self.p_proxy = SimProcess("proxy")
+        self.p_resolver = SimProcess("resolver")
+        self.p_storage = SimProcess("storage")  # hosts log + storage
+
+        self.master = Master(0)
+        self.resolver = ResolverRole(ConflictSetCPU(0), 0)
+        self.tlog = MemoryTLog(0)
+        self.storage = StorageServer(self.tlog, 0)
+        self._role_tasks = [
+            self.resolver.start_serving(),
+            self.tlog.start_serving(),
+        ]
+        self.storage.start()
+        self.proxy = CommitProxy(
+            self.master, self.resolver, self.tlog,
+            resolver_endpoint=RemoteStream(
+                self.net, self.p_proxy, self.p_resolver,
+                self.resolver.resolve_stream,
+            ),
+            tlog_endpoint=RemoteStream(
+                self.net, self.p_proxy, self.p_storage,
+                self.tlog.commit_stream,
+            ),
+        )
+        self.proxy.start()
+        self.conn = ClusterConnection(
+            RemoteStream(self.net, self.p_client, self.p_proxy,
+                         self.proxy.grv_stream),
+            RemoteStream(self.net, self.p_client, self.p_proxy,
+                         self.proxy.commit_stream),
+            RemoteStream(self.net, self.p_client, self.p_storage,
+                         self.storage.read_stream),
+        )
+
+    def database(self) -> Database:
+        return Database(self, conn=self.conn)
+
+    def stop(self):
+        self.proxy.stop()
+        self.storage.stop()
+        for t in self._role_tasks:
+            t.cancel()
+
+
+def test_cycle_over_role_distributed_pipeline():
+    """Cycle with every commit crossing proxy->resolver and proxy->log over
+    the network, under periodic clogs of the ROLE links (delays, not
+    drops: reliable-until-failure delivery, as with FlowTransport; role
+    blackout recovery is the recovery tier's test)."""
+    loop = sim_loop(seed=17)
+    with loop_context(loop):
+        rdc = RoleDistributedCluster()
+        db = rdc.database()
+
+        async def main():
+            from foundationdb_tpu.core.runtime import spawn
+
+            wl = CycleWorkload(db, nodes=10)
+            await wl.setup()
+
+            async def clogger():
+                while True:
+                    await current_loop().delay(0.08)
+                    r = current_loop().random
+                    pair = [
+                        (rdc.p_proxy, rdc.p_resolver),
+                        (rdc.p_proxy, rdc.p_storage),
+                        (rdc.p_client, rdc.p_proxy),
+                    ][r.random_int(0, 3)]
+                    rdc.net.clog_pair(*pair, seconds=0.1 * r.random01())
+
+            c = spawn(clogger(), name="role_clogger")
+            await wl.start(clients=3, txns_per_client=10)
+            ok = await wl.check()
+            c.cancel()
+            rdc.stop()
+            return ok, wl.txns_done
+
+        ok, done = loop.run(main(), timeout_sim_seconds=1e6)
+    assert ok and done == 30
+
+
+def test_lost_role_rpc_fails_batch_as_maybe_committed():
+    """A blackout on the proxy->resolver link: the batch times out at the
+    role-RPC deadline, clients get commit_unknown_result, the version
+    chains advance via compensation, and after the link heals the retry
+    commits — no wedge, no double-apply (the dedup pattern covers the
+    ambiguity)."""
+    from foundationdb_tpu.core.errors import CommitUnknownResult
+    from foundationdb_tpu.core.knobs import SERVER_KNOBS
+
+    loop = sim_loop(seed=23)
+    with loop_context(loop):
+        rdc = RoleDistributedCluster()
+        db = rdc.database()
+
+        async def main():
+            await db.set(b"k", b"0")
+            rdc.net.blackout(rdc.p_resolver)
+
+            tr = db.create_transaction()
+            tr.set(b"k", b"1")
+            t0 = current_loop().now()
+            try:
+                await tr.commit()
+                raise AssertionError("expected CommitUnknownResult")
+            except CommitUnknownResult:
+                pass
+            # The failure surfaced at the role-RPC deadline, not the (much
+            # larger) client commit timeout — the server-side fence did it.
+            assert current_loop().now() - t0 < SERVER_KNOBS.ROLE_RPC_TIMEOUT * 2
+
+            rdc.net.restore(rdc.p_resolver)
+            await tr.on_error(CommitUnknownResult())
+            if await tr.get(b"k") == b"0":  # ambiguity resolved by re-read
+                tr.set(b"k", b"1")
+                await tr.commit()
+            assert await db.get(b"k") == b"1"
+            rdc.stop()
+
+        loop.run(main(), timeout_sim_seconds=1e6)
