@@ -270,36 +270,67 @@ def _resolve_kernel_impl(hmat, n, fused, *, lay: FusedLayout):
     )
 
     # ============ Phase 3: merge-by-rank + coalesce + compact ============
+    # Only WRITE endpoints can ever enter the history (read endpoints never
+    # merge — they were dropped as invalid points anyway), so the merge
+    # space is C + 2*Wr, independent of the READ count: for scan-heavy
+    # workloads (YCSB-E, 64 read ranges/txn) this shrinks the whole phase
+    # by an order of magnitude.
     committed_w = w_valid & (conflict[wtxn] == 0)
-    N3 = C + P2
+    M = 2 * Wr
+    N3 = C + M
 
-    # Merge duality: #endpoints < hist[j] = #{p : ub[p] <= j}. One
-    # scatter-count over ub plus a prefix sum replaces a second search.
-    cnt_ub = jnp.zeros(C + 1, dtype=i32).at[jnp.minimum(ub, C)].add(1)
+    # Compact the write endpoints out of the full sorted-endpoint space,
+    # preserving their relative sorted order: rank among write endpoints
+    # via one scatter + prefix sum, then per-write-row slot assignment
+    # (every sorted slot holds at most one endpoint, so slots are unique).
+    is_w = jnp.zeros(P2, dtype=i32).at[
+        jnp.concatenate([s_begin, s_end])
+    ].set(1)
+    w_rank = jnp.cumsum(is_w) - is_w
+    wb_slot = w_rank[s_begin]
+    we_slot = w_rank[s_end]
+    # ONE scatter carries everything per compacted endpoint, bit-packed:
+    # bit0 committed, bit1 is-begin, bits2+ global sorted position.
+    cw_i32 = committed_w.astype(i32)
+    packed_ep = jnp.zeros(M, dtype=i32).at[
+        jnp.concatenate([wb_slot, we_slot])
+    ].set(jnp.concatenate([
+        (s_begin << 2) + 2 + cw_i32,
+        (s_end << 2) + cw_i32,
+    ]))
+    sidx = packed_ep >> 2  # global sorted position of the i-th endpoint
+    is_begin_c = (packed_ep >> 1) & 1
+    committed_c = packed_ep & 1
+    cwb = committed_c & is_begin_c
+    cwe = committed_c & (1 - is_begin_c)
+    ub_c = ub[sidx]
+    eq_c = eq[sidx]
+
+    # Merge duality: #write-endpoints < hist[j] = #{p : ub_c[p] <= j}. One
+    # scatter-count over ub_c plus a prefix sum replaces a second search.
+    cnt_ub = jnp.zeros(C + 1, dtype=i32).at[jnp.minimum(ub_c, C)].add(1)
     lbB = jnp.cumsum(cnt_ub[:C])
     posA = jnp.arange(C, dtype=i32) + lbB          # history -> merged
-    posB = jnp.arange(P2, dtype=i32) + ub          # endpoints -> merged
+    posB = jnp.arange(M, dtype=i32) + ub_c         # write endpoints -> merged
     # Ties are history-first, so merged positions are a permutation of N3.
-
-    # Committed flags per sorted endpoint slot (write rows -> their slots).
-    cwb = jnp.zeros(P2, dtype=i32).at[s_begin].set(committed_w.astype(i32))
-    cwe = jnp.zeros(P2, dtype=i32).at[s_end].set(committed_w.astype(i32))
 
     # same-as-previous in merged space. History entries are unique and equal
     # endpoints sort after their equal history entry, so a history element is
-    # never equal to its merged predecessor; an endpoint's predecessor is the
-    # previous endpoint iff their merged positions are adjacent, else it is
-    # history entry ub-1 (equal to the key iff eq).
-    same_ep = jnp.concatenate(
+    # never equal to its merged predecessor; a write endpoint's predecessor
+    # is the previous write endpoint iff their merged positions are adjacent
+    # (then compare keys directly), else history entry ub_c-1 (equal to the
+    # key iff eq_c).
+    kw_c = smat[:, sidx]                           # (W+1, M) keys + len
+    same_w = jnp.concatenate(
         [
             jnp.zeros(1, dtype=bool),
-            jnp.all(smat[:, 1:] == smat[:, :-1], axis=0),
+            jnp.all(kw_c[:, 1:] == kw_c[:, :-1], axis=0),
         ]
     )
     prev_is_ep = jnp.concatenate(
         [jnp.zeros(1, dtype=bool), posB[1:] == posB[:-1] + 1]
     )
-    same_prev_ep = jnp.where(prev_is_ep, same_ep, eq & (ub > 0))
+    same_prev_ep = jnp.where(prev_is_ep, same_w, eq_c & (ub_c > 0))
 
     # Bit-packed merged planes, built with ONE scatter over all N3 slots:
     # bit0 is_hist, bit1 cwb, bit2 cwe, bit3 same_prev, bits4+ source column
@@ -311,7 +342,7 @@ def _resolve_kernel_impl(hmat, n, fused, *, lay: FusedLayout):
         (cwb << 1)
         + (cwe << 2)
         + (same_prev_ep.astype(i32) << 3)
-        + ((C + jnp.arange(P2, dtype=i32)) << 4)
+        + ((C + sidx) << 4)
     )
     merged = (
         jnp.zeros(N3, dtype=i32)
@@ -373,11 +404,12 @@ def _resolve_kernel_impl(hmat, n, fused, *, lay: FusedLayout):
     src2 = jnp.zeros(C + 1, dtype=i32).at[dest2].max(csrc)[:C]
     hv_new = jnp.zeros(C + 1, dtype=i32).at[dest2].max(cval)[:C]
 
-    # Materialize keys: src is the column in [history | sorted endpoints],
-    # so ONE 2D gather from the concatenation yields words + len together.
+    # Materialize keys: src is the column in [history | sorted endpoints]
+    # (endpoint sources use their ORIGINAL P2-space position), so ONE 2D
+    # gather from the concatenation yields words + len together.
     all_keys = jnp.concatenate([hkeys, smat], axis=1)
     live = jnp.arange(C, dtype=i32) < new_n
-    picked = all_keys[:, jnp.clip(src2, 0, N3 - 1)]
+    picked = all_keys[:, jnp.clip(src2, 0, C + P2 - 1)]
     pad_col = jnp.concatenate(
         [jnp.full(W, PAD_WORD, dtype=i32), jnp.full(1, INT32_MAX, dtype=i32)]
     )
