@@ -33,6 +33,127 @@ class Counter:
         return self
 
 
+class ContinuousSample:
+    """Reservoir sample for latency percentiles (ref:
+    fdbrpc/ContinuousSample.h:31). Keeps a fixed-size uniform sample of an
+    unbounded stream; percentiles are read from the sorted reservoir."""
+
+    __slots__ = ("size", "samples", "population", "_sorted", "_random")
+
+    def __init__(self, size: int = 500, random=None):
+        self.size = size
+        self.samples: list = []
+        self.population = 0
+        self._sorted = False
+        self._random = random
+
+    def _rand_below(self, n: int) -> int:
+        if self._random is not None:
+            return self._random.random_int(0, n)
+        from .runtime import current_loop
+
+        return current_loop().random.random_int(0, n)
+
+    def add_sample(self, value) -> None:
+        self.population += 1
+        if len(self.samples) < self.size:
+            self.samples.append(value)
+            self._sorted = False
+        elif self._rand_below(self.population) < self.size:
+            self.samples[self._rand_below(self.size)] = value
+            self._sorted = False
+
+    def percentile(self, q: float):
+        """q in [0, 1]; None on an empty sample."""
+        if not self.samples:
+            return None
+        if not self._sorted:
+            self.samples.sort()
+            self._sorted = True
+        idx = min(len(self.samples) - 1, int(q * len(self.samples)))
+        return self.samples[idx]
+
+    def median(self):
+        return self.percentile(0.5)
+
+    def mean(self):
+        return sum(self.samples) / len(self.samples) if self.samples else None
+
+    def clear(self) -> None:
+        self.samples.clear()
+        self.population = 0
+        self._sorted = False
+
+
+class Smoother:
+    """Exponential smoother over continuous (wall/sim) time (ref:
+    fdbrpc/Smoother.h). `smooth_total()` converges toward the last set
+    total with time constant e-folding time `e_folding_time`;
+    `smooth_rate()` is the smoothed derivative — the reference uses these
+    for queue depths and rates in Ratekeeper and LoadBalance."""
+
+    __slots__ = ("e_folding_time", "total", "_time", "_estimate")
+
+    def __init__(self, e_folding_time: float):
+        self.e_folding_time = e_folding_time
+        self.total = 0.0
+        self._time = None
+        self._estimate = 0.0
+
+    def _now(self) -> float:
+        from .runtime import current_loop
+
+        return current_loop().now()
+
+    def reset(self, value: float) -> None:
+        self.total = value
+        self._estimate = value
+        self._time = None
+
+    def set_total(self, total: float) -> None:
+        self._update()
+        self.total = total
+
+    def add_delta(self, delta: float) -> None:
+        self._update()
+        self.total += delta
+
+    def _update(self) -> None:
+        import math
+
+        t = self._now()
+        if self._time is None:
+            self._time = t
+            self._estimate = self.total
+            return
+        dt = t - self._time
+        if dt > 0:
+            self._time = t
+            self._estimate += (self.total - self._estimate) * (
+                1 - math.exp(-dt / self.e_folding_time)
+            )
+
+    def smooth_total(self) -> float:
+        self._update()
+        return self._estimate
+
+    def smooth_rate(self) -> float:
+        """Rate at which the estimate is moving toward the total."""
+        self._update()
+        return (self.total - self._estimate) / self.e_folding_time
+
+
+class TimerSmoother(Smoother):
+    """Smoother whose estimate decays toward the total but never past it —
+    used for timers that only ratchet up (ref: fdbrpc/Smoother.h:71)."""
+
+    def add_delta(self, delta: float) -> None:
+        self._update()
+        self.total += delta
+        if delta > 0:
+            self._estimate += delta
+
+
 class CounterCollection:
     def __init__(self, name: str, id_: str = ""):
         self.name = name

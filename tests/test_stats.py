@@ -1,0 +1,86 @@
+"""ContinuousSample / Smoother / Counter tests (ref: fdbrpc/ContinuousSample.h,
+fdbrpc/Smoother.h, flow/Stats.h)."""
+
+import pytest
+
+from foundationdb_tpu.core import delay, sim_loop, loop_context
+from foundationdb_tpu.core.rand import DeterministicRandom
+from foundationdb_tpu.core.stats import (
+    ContinuousSample,
+    Counter,
+    CounterCollection,
+    Smoother,
+    TimerSmoother,
+)
+
+
+def test_continuous_sample_small_stream_exact():
+    s = ContinuousSample(size=100, random=DeterministicRandom(1))
+    for v in range(50):
+        s.add_sample(v)
+    assert s.population == 50
+    assert s.median() == 25
+    assert s.percentile(0.0) == 0
+    assert s.percentile(0.99) == 49
+
+
+def test_continuous_sample_reservoir_is_representative():
+    s = ContinuousSample(size=500, random=DeterministicRandom(7))
+    for v in range(20000):
+        s.add_sample(v)
+    assert s.population == 20000
+    assert len(s.samples) == 500
+    med = s.median()
+    # Uniform stream: the sampled median should land near the true median.
+    assert 20000 * 0.3 < med < 20000 * 0.7
+    s.clear()
+    assert s.median() is None
+
+
+def test_smoother_converges_and_rates():
+    loop = sim_loop(seed=3)
+    with loop_context(loop):
+
+        async def main():
+            sm = Smoother(e_folding_time=1.0)
+            sm.set_total(100.0)
+            await delay(10.0)  # ~10 e-foldings
+            assert sm.smooth_total() == pytest.approx(100.0, abs=0.1)
+            # Once converged the rate is ~0.
+            assert abs(sm.smooth_rate()) < 0.1
+            sm.add_delta(50.0)
+            # Smoother moves gradually: immediately after the delta the
+            # estimate hasn't jumped.
+            assert sm.smooth_total() < 110.0
+
+        loop.run(main())
+
+
+def test_timer_smoother_jumps_up_decays_down():
+    loop = sim_loop(seed=3)
+    with loop_context(loop):
+
+        async def main():
+            sm = TimerSmoother(e_folding_time=2.0)
+            sm.add_delta(10.0)
+            # Positive deltas are reflected immediately.
+            assert sm.smooth_total() == pytest.approx(10.0)
+            sm.set_total(0.0)
+            await delay(20.0)
+            assert sm.smooth_total() == pytest.approx(0.0, abs=0.01)
+
+        loop.run(main())
+
+
+def test_counter_collection_flush_resets_window(sim):
+    cc = CounterCollection("TestRole", "id1")
+    c = cc.counter("Ops")
+    c += 5
+
+    async def main():
+        await delay(1.0)
+        cc.flush(1.0)
+        assert c.total == 5
+        assert c._window == 0
+
+    sim.run(main())
