@@ -1,0 +1,176 @@
+"""FailureMonitor + ReplicationPolicy tests (ref: fdbrpc/FailureMonitor.h,
+fdbrpc/ReplicationPolicy.h)."""
+
+import pytest
+
+from foundationdb_tpu.cluster.failure_monitor import (
+    FailureDetectionServer,
+    FailureMonitor,
+    failure_monitor_client,
+    heartbeater,
+)
+from foundationdb_tpu.cluster.replication import (
+    LocalityData,
+    PolicyAcross,
+    PolicyAnd,
+    PolicyOne,
+    Replica,
+    policy_for_mode,
+)
+from foundationdb_tpu.core import delay
+from foundationdb_tpu.core.rand import DeterministicRandom
+from foundationdb_tpu.sim.network import SimNetwork, SimProcess, RemoteStream
+
+
+# ---------------- ReplicationPolicy ----------------
+
+def _fleet(n_dc=3, machines_per_dc=4):
+    out = []
+    for d in range(n_dc):
+        for m in range(machines_per_dc):
+            out.append(
+                Replica(
+                    f"s{d}_{m}",
+                    LocalityData(
+                        processid=f"p{d}{m}",
+                        zoneid=f"z{d}{m}",
+                        machineid=f"m{d}{m}",
+                        dcid=f"dc{d}",
+                        data_hall=f"h{d}",
+                    ),
+                )
+            )
+    return out
+
+
+def test_policy_one():
+    p = PolicyOne()
+    fleet = _fleet()
+    sel = p.select_replicas(fleet, random=DeterministicRandom(1))
+    assert len(sel) == 1
+    assert p.validate(sel)
+    assert not p.validate([])
+
+
+def test_policy_across_zones():
+    p = policy_for_mode("triple")  # Across(3, zoneid, One)
+    fleet = _fleet()
+    sel = p.select_replicas(fleet, random=DeterministicRandom(2))
+    assert len(sel) == 3
+    assert len({r.locality.zoneid for r in sel}) == 3
+    assert p.validate(sel)
+    # Two in the same zone + one other never validates triple.
+    same_zone = [fleet[0], fleet[0], fleet[1]]
+    assert not p.validate(same_zone)
+
+
+def test_policy_across_respects_already():
+    p = PolicyAcross(3, "zoneid", PolicyOne())
+    fleet = _fleet()
+    already = fleet[:2]  # two distinct zones already held
+    sel = p.select_replicas(fleet, already, random=DeterministicRandom(3))
+    assert len(sel) == 1  # only one more zone needed
+    assert p.validate(list(already) + sel)
+
+
+def test_policy_across_impossible():
+    p = PolicyAcross(4, "dcid", PolicyOne())
+    fleet = _fleet(n_dc=3)
+    assert p.select_replicas(fleet, random=DeterministicRandom(4)) is None
+
+
+def test_three_datacenter_policy():
+    p = policy_for_mode("three_datacenter")
+    fleet = _fleet(n_dc=3)
+    sel = p.select_replicas(fleet, random=DeterministicRandom(5))
+    assert sel is not None
+    assert p.validate(sel)
+    assert len({r.locality.dcid for r in sel}) == 3
+    # All in one DC fails the And.
+    one_dc = [r for r in fleet if r.locality.dcid == "dc0"]
+    assert not p.validate(one_dc[:3])
+
+
+def test_policy_and_num_replicas_and_describe():
+    p = PolicyAnd(PolicyAcross(3, "dcid", PolicyOne()),
+                  PolicyAcross(2, "zoneid", PolicyOne()))
+    assert p.num_replicas() == 3
+    assert "Across(3, dcid" in p.describe()
+
+
+def test_selection_is_deterministic():
+    p = policy_for_mode("triple")
+    fleet = _fleet()
+    a = p.select_replicas(fleet, random=DeterministicRandom(9))
+    b = p.select_replicas(fleet, random=DeterministicRandom(9))
+    assert [r.id for r in a] == [r.id for r in b]
+
+
+# ---------------- FailureMonitor ----------------
+
+def test_failure_detection_and_recovery(sim):
+    async def main():
+        net = SimNetwork()
+        cc = SimProcess("cc")
+        procs = [SimProcess(f"w{i}") for i in range(3)]
+        server = FailureDetectionServer()
+        server.start()
+
+        beats = [
+            heartbeater(
+                RemoteStream(net, p, cc, server.stream), p.name, interval=0.2
+            )
+            for p in procs
+        ]
+        # Observer process mirroring the server's view.
+        obs = SimProcess("obs")
+        mon = FailureMonitor()
+        client = failure_monitor_client(
+            RemoteStream(net, obs, cc, server.stream), mon, "obs"
+        )
+
+        await delay(2.0)
+        assert not server.state.failed  # everyone beating
+
+        net.blackout(procs[1])  # w1 goes silent
+        await mon.on_failed("w1")  # observer sees it via the mirror
+        assert server.state.failed == frozenset({"w1"})
+        assert mon.is_failed("w1") and not mon.is_failed("w0")
+
+        net.restore(procs[1])
+        await mon.on_healthy("w1")
+        assert not server.state.failed
+
+        for t in beats:
+            t.cancel()
+        client.cancel()
+        server.stop()
+
+    sim.run(main())
+
+
+def test_partitioned_process_declared_failed_not_others(sim):
+    async def main():
+        net = SimNetwork()
+        cc = SimProcess("cc")
+        a, b = SimProcess("a"), SimProcess("b")
+        server = FailureDetectionServer()
+        server.start()
+        beats = [
+            heartbeater(RemoteStream(net, p, cc, server.stream), p.name,
+                        interval=0.2)
+            for p in (a, b)
+        ]
+        await delay(1.0)
+        net.partition(a, cc)  # a's beats are dropped in flight
+        await delay(3.0)
+        assert "a" in server.state.failed
+        assert "b" not in server.state.failed
+        net.heal(a, cc)
+        await delay(2.0)
+        assert "a" not in server.state.failed
+        for t in beats:
+            t.cancel()
+        server.stop()
+
+    sim.run(main())
