@@ -107,3 +107,32 @@ class ResolveTransactionBatchRequest:
     last_receive_version: int
     transactions: list  # list[TxnConflictInfo]
     reply: Promise = field(default_factory=Promise)
+
+
+# -- wire registration: every interface message is serializable, so the
+#    same role code runs over the in-process streams, the sim network, and
+#    the real FlowTransport (ref: the serializer specializations each
+#    *Interface.h declares for its request structs). --
+
+def _register_wire_types() -> None:
+    from ..core.serialize import register_enum, register_message
+    from ..resolver.types import TxnConflictInfo
+
+    for cls in (
+        Mutation,
+        GetReadVersionRequest,
+        CommitTransactionRequest,
+        CommitID,
+        GetValueRequest,
+        GetRangeRequest,
+        WatchValueRequest,
+        TLogCommitRequest,
+        ResolveTransactionBatchRequest,
+        KeyRange,
+        TxnConflictInfo,
+    ):
+        register_message(cls)
+    register_enum(MutationType)
+
+
+_register_wire_types()

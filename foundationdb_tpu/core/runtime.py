@@ -274,6 +274,10 @@ class EventLoop:
         self.buggify_on = False
         self.tasks_run = 0
         self.current_task: Optional[Task] = None
+        # Optional I/O reactor (real-clock loops only): polled when the
+        # loop would otherwise sleep, so socket readiness wakes actors
+        # (ref: ASIOReactor::sleepAndReact, flow/Net2.actor.cpp:948).
+        self.reactor = None
 
     # -- time --
     def now(self) -> float:
@@ -366,6 +370,29 @@ class EventLoop:
                     f"livelock: {self._steps_at_instant} steps without time advancing (t={self.now()})"
                 )
             self._step(task, value, exc)
+            # Keep sockets serviced under a flood of ready tasks (the
+            # reference reacts between task-queue drains, Net2.actor.cpp:570).
+            if self.reactor is not None and self.tasks_run % 64 == 0:
+                self.reactor.poll(0.0)
+            return True
+        if self.reactor is not None:
+            # Due timers fire before any socket work so a continuously
+            # readable fd cannot starve the timer heap.
+            if self._timers and self._timers[0][0] <= self.now():
+                self._steps_at_instant = 0
+                while self._timers and self._timers[0][0] <= self.now():
+                    _, _, _, p = heapq.heappop(self._timers)
+                    if not p.is_set():
+                        p.send(None)
+                return True
+            # Idle in the task queue: block in select() in bounded slices
+            # so fd readiness wakes actors long before a distant timer;
+            # never fall through to advance_to()'s blocking sleep.
+            wait = 0.02
+            if self._timers:
+                wait = max(0.0, min(self._timers[0][0] - self.now(), wait))
+            if self.reactor.poll(wait):
+                self._steps_at_instant = 0
             return True
         if self._timers:
             t, _, _, _ = self._timers[0]
@@ -377,7 +404,8 @@ class EventLoop:
                 if not p.is_set():
                     p.send(None)
             return True
-        return False
+        # A reactor with no timers still waits for I/O (a pure server).
+        return self.reactor is not None
 
     def run_until(self, fut: Future, timeout_sim_seconds: float = 1e9) -> Any:
         """Drive the loop until `fut` resolves; returns/raises its value."""

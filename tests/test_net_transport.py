@@ -1,0 +1,234 @@
+"""Serialization + real-TCP FlowTransport tests (ref: flow/serialize.h,
+fdbrpc/FlowTransport.actor.cpp). These run over real loopback sockets on
+a real-clock loop — the non-simulated half of the INetwork seam."""
+
+import struct
+
+import pytest
+
+from foundationdb_tpu.cluster.interfaces import (
+    CommitTransactionRequest,
+    GetValueRequest,
+    Mutation,
+)
+from foundationdb_tpu.core import loop_context
+from foundationdb_tpu.core.actors import PromiseStream, serve_requests, timeout_error
+from foundationdb_tpu.core.errors import ConnectionFailed, NotCommitted
+from foundationdb_tpu.core.runtime import TaskPriority
+from foundationdb_tpu.core.serialize import (
+    BinaryReader,
+    BinaryWriter,
+    ProtocolVersionMismatch,
+    crc32c,
+    decode_message,
+    encode_message,
+)
+from foundationdb_tpu.kv.atomic import MutationType
+from foundationdb_tpu.kv.keys import KeyRange
+from foundationdb_tpu.net import real_loop_with_transport
+
+
+# ---------------- serialization ----------------
+
+def test_binary_writer_reader_roundtrip():
+    w = BinaryWriter()
+    w.write_protocol_version()
+    w.u8(7).u32(1 << 30).i64(-5).u64(1 << 60).f64(2.5)
+    w.bytes_(b"\x00\xff").string("héllo")
+    r = BinaryReader(w.to_bytes())
+    r.check_protocol_version()
+    assert r.u8() == 7
+    assert r.u32() == 1 << 30
+    assert r.i64() == -5
+    assert r.u64() == 1 << 60
+    assert r.f64() == 2.5
+    assert r.bytes_() == b"\x00\xff"
+    assert r.string() == "héllo"
+    assert r.empty()
+
+
+def test_protocol_version_mismatch_rejected():
+    w = BinaryWriter()
+    w.u64(0xDEAD00)
+    with pytest.raises(ProtocolVersionMismatch):
+        BinaryReader(w.to_bytes()).check_protocol_version()
+
+
+def test_message_roundtrip_preserves_everything_but_reply():
+    req = CommitTransactionRequest(
+        read_snapshot=42,
+        read_conflict_ranges=[KeyRange(b"a", b"b\x00")],
+        write_conflict_ranges=(KeyRange(b"c", b"d"),),
+        mutations=[Mutation(MutationType.ADD_VALUE, b"k", b"\x01")],
+    )
+    out = decode_message(encode_message(req))
+    assert out.read_snapshot == 42
+    assert list(out.read_conflict_ranges) == [KeyRange(b"a", b"b\x00")]
+    assert out.mutations[0].type == MutationType.ADD_VALUE
+    assert out.reply is not req.reply  # fresh promise, never serialized
+
+
+def test_error_values_cross_the_codec():
+    err = decode_message(encode_message(NotCommitted("boom")))
+    assert isinstance(err, NotCommitted)
+    assert err.code == 1020
+
+
+def test_crc32c_known_vectors():
+    # Standard CRC32-C test vectors (RFC 3720 appendix B.4 style).
+    assert crc32c(b"") == 0
+    assert crc32c(b"123456789") == 0xE3069283
+    assert crc32c(b"\x00" * 32) == 0x8A9136AA
+
+
+# ---------------- transport over real sockets ----------------
+
+def _kv_server(transport):
+    """Register a tiny kv endpoint; returns (token, dict)."""
+    data = {b"hello": b"world"}
+    stream = PromiseStream()
+
+    async def handle(req):
+        if isinstance(req, GetValueRequest):
+            return data.get(req.key)
+        if isinstance(req, CommitTransactionRequest):
+            if req.read_snapshot < 0:
+                raise NotCommitted()
+            for m in req.mutations:
+                data[m.param1] = m.param2
+            return len(data)
+        raise TypeError(type(req))
+
+    serve_requests(stream, handle, TaskPriority.DEFAULT, "kv")
+    token = transport.register_endpoint(stream)
+    return token, data
+
+
+def test_request_reply_over_real_sockets():
+    loop, t_client = real_loop_with_transport()
+    with loop_context(loop):
+        from foundationdb_tpu.net import FlowTransport
+
+        t_server = FlowTransport(loop.reactor)
+        token, data = _kv_server(t_server)
+        remote = t_client.remote_stream(t_server.local_address, token)
+
+        async def main():
+            # Read.
+            req = GetValueRequest(key=b"hello", version=1)
+            remote.send(req)
+            assert await timeout_error(req.reply.future, 5.0) == b"world"
+            # Write (big enough value to exercise framing).
+            big = bytes(range(256)) * 1024  # 256 KB
+            c = CommitTransactionRequest(
+                read_snapshot=1, read_conflict_ranges=(),
+                write_conflict_ranges=(),
+                mutations=[Mutation(MutationType.SET_VALUE, b"big", big)],
+            )
+            remote.send(c)
+            assert await timeout_error(c.reply.future, 5.0) == 2
+            assert data[b"big"] == big
+            # Server-side error propagates as the typed error.
+            bad = CommitTransactionRequest(
+                read_snapshot=-1, read_conflict_ranges=(),
+                write_conflict_ranges=(), mutations=(),
+            )
+            remote.send(bad)
+            with pytest.raises(NotCommitted):
+                await timeout_error(bad.reply.future, 5.0)
+
+        loop.run(main(), timeout_sim_seconds=30.0)
+        t_server.close()
+        t_client.close()
+
+
+def test_connection_refused_fails_pending_replies():
+    loop, t_client = real_loop_with_transport()
+    with loop_context(loop):
+        # Nobody listens on this port (bind+close to find a free one).
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        dead = f"127.0.0.1:{s.getsockname()[1]}"
+        s.close()
+        remote = t_client.remote_stream(dead, 42)
+
+        async def main():
+            req = GetValueRequest(key=b"x", version=1)
+            remote.send(req)
+            with pytest.raises(ConnectionFailed):
+                await timeout_error(req.reply.future, 5.0)
+
+        loop.run(main(), timeout_sim_seconds=30.0)
+        t_client.close()
+
+
+def test_corrupt_frame_drops_connection():
+    """Checksum-failing frames must close the connection, not crash or
+    deliver garbage (ref: scanPackets' checksum rejection)."""
+    loop, t_server = real_loop_with_transport()
+    with loop_context(loop):
+        token, data = _kv_server(t_server)
+        import socket
+
+        async def main():
+            host, port = t_server.local_address.rsplit(":", 1)
+            raw = socket.create_connection((host, int(port)))
+            payload = b"garbage-payload"
+            raw.sendall(struct.pack("<II", len(payload), 12345) + payload)
+            # Give the server loop time to read + reject.
+            from foundationdb_tpu.core import delay
+
+            await delay(0.2)
+            # Connection should be closed by the server.
+            raw.settimeout(1.0)
+            assert raw.recv(1) == b""
+            raw.close()
+
+        loop.run(main(), timeout_sim_seconds=30.0)
+        t_server.close()
+
+
+def test_tls_request_reply(tmp_path):
+    """Mutual-TLS transport pair (ref: FDBLibTLS policy contexts wrapped
+    around IConnection). Gated on the openssl CLI for cert generation."""
+    import shutil
+    import subprocess
+
+    if shutil.which("openssl") is None:
+        pytest.skip("no openssl CLI to mint test certs")
+    cert = tmp_path / "cert.pem"
+    key = tmp_path / "key.pem"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(cert), "-days", "1",
+         "-subj", "/CN=127.0.0.1"],
+        check=True, capture_output=True,
+    )
+    from foundationdb_tpu.net import FlowTransport, SelectReactor
+    from foundationdb_tpu.net.tls import client_context, server_context
+    from foundationdb_tpu.core.runtime import EventLoop
+
+    loop = EventLoop()
+    loop.reactor = SelectReactor()
+    with loop_context(loop):
+        t_server = FlowTransport(
+            loop.reactor,
+            tls_server=server_context(str(cert), str(key),
+                                      require_client_cert=False),
+        )
+        t_client = FlowTransport(
+            loop.reactor, tls_client=client_context(ca_path=str(cert))
+        )
+        token, data = _kv_server(t_server)
+        remote = t_client.remote_stream(t_server.local_address, token)
+
+        async def main():
+            req = GetValueRequest(key=b"hello", version=1)
+            remote.send(req)
+            assert await timeout_error(req.reply.future, 10.0) == b"world"
+
+        loop.run(main(), timeout_sim_seconds=60.0)
+        t_server.close()
+        t_client.close()
