@@ -93,3 +93,217 @@ class ClusterConnection:
             # client ambiguity (ref: commit_unknown_result).
             raise CommitUnknownResult()
         return result
+
+
+class ShardedConnection(ClusterConnection):
+    """Client view of a sharded, replicated cluster: reads are routed by a
+    location cache and load-balanced across each shard's replica team
+    (ref: getKeyLocation, fdbclient/NativeAPI.actor.cpp:1059 + loadBalance
+    per-shard reads :1146,1367; cache invalidation on wrong_shard_server
+    :1176-1180).
+
+    `storage_endpoints` maps storage tag -> read endpoint;
+    `location_endpoint` answers GetKeyServerLocationsRequest from the
+    proxy's shard map.
+    """
+
+    def __init__(self, grv_endpoint, commit_endpoint, location_endpoint,
+                 storage_endpoints: dict, failure_monitor=None,
+                 failure_names: Optional[dict] = None):
+        super().__init__(grv_endpoint, commit_endpoint,
+                         storage_endpoint=None)
+        self.location_endpoint = location_endpoint
+        self.storage_endpoints = dict(storage_endpoints)
+        self.failure_monitor = failure_monitor
+        self.failure_names = failure_names or {}
+        from ..kv.keyrange_map import KeyRangeMap
+
+        self._locations = KeyRangeMap(None)  # key -> (end, team) | None
+        from .load_balance import QueueModel
+
+        self.queue_model = QueueModel()
+
+    # -- location cache (ref: getKeyLocation/locationCache) --
+    async def _locate(self, key: bytes) -> tuple[bytes, tuple]:
+        """(shard_end, team) for the shard containing `key`."""
+        hit = self._locations[key]
+        if hit is not None:
+            return hit
+        from ..cluster.shards import GetKeyServerLocationsRequest
+        from ..kv.keys import KeyRange, key_after
+
+        slices = await self._retrying(
+            lambda: GetKeyServerLocationsRequest(key, key_after(key)),
+            self.location_endpoint, CLIENT_KNOBS.READ_TIMEOUT,
+        )
+        for b, e, team in slices:
+            self._locations.insert(KeyRange(b, e), (e, tuple(team)))
+        hit = self._locations[key]
+        if hit is None:
+            from ..core.errors import OperationFailed
+
+            raise OperationFailed(f"no shard location for {key!r}")
+        return hit
+
+    def _invalidate(self, key: bytes) -> None:
+        """(ref: invalidateCache on wrong_shard_server)."""
+        from ..kv.keys import KeyRange, key_after
+
+        hit = self._locations[key]
+        end = hit[0] if hit else key_after(key)
+        self._locations.insert(
+            KeyRange(key, max(end, key_after(key))), None
+        )
+
+    def _alternatives(self, team: tuple):
+        return [(t, self.storage_endpoints[t]) for t in team
+                if t in self.storage_endpoints]
+
+    async def _shard_read(self, key_for_routing: bytes, make_req):
+        """One load-balanced read against key_for_routing's team, with
+        location-cache invalidation + retry on wrong_shard_server."""
+        from ..core.errors import WrongShardServer
+        from .load_balance import load_balance
+
+        while True:
+            _, team = await self._locate(key_for_routing)
+            try:
+                return await load_balance(
+                    self.queue_model, self._alternatives(team), make_req,
+                    self.failure_monitor, self.failure_names,
+                )
+            except WrongShardServer:
+                self._invalidate(key_for_routing)
+
+    async def get_value(self, key: bytes, version: int):
+        return await self._shard_read(
+            key, lambda: GetValueRequest(key, version)
+        )
+
+    async def _read_slice(self, cursor: bytes, end: bytes, version, limit,
+                          reverse):
+        """One shard-sized sub-read, RE-LOCATING on every attempt: a shard
+        boundary that moves mid-read must shrink the request to the new
+        shard, not livelock on a frozen range (ref: getExactRange's
+        re-resolution after wrong_shard_server, NativeAPI.actor.cpp:1445).
+        Returns (rows, sub_end_used)."""
+        from ..core.errors import WrongShardServer
+        from .load_balance import load_balance
+
+        while True:
+            shard_end, team = await self._locate(cursor)
+            sub_end = min(shard_end, end)
+            try:
+                rows = await load_balance(
+                    self.queue_model, self._alternatives(team),
+                    lambda c=cursor, se=sub_end: GetRangeRequest(
+                        c, se, version, limit, reverse,
+                    ),
+                    self.failure_monitor, self.failure_names,
+                )
+                return rows, sub_end
+            except WrongShardServer:
+                self._invalidate(cursor)
+
+    async def get_range(self, begin, end, version, limit=0, reverse=False):
+        """Iterates shard slices, reading each from its own team (ref:
+        getExactRange's per-shard loop, NativeAPI.actor.cpp:1367)."""
+        out = []
+        remaining = limit if limit else 0
+        if not reverse:
+            cursor = begin
+            while cursor < end:
+                rows, sub_end = await self._read_slice(
+                    cursor, end, version, remaining, False
+                )
+                out.extend(rows)
+                if limit:
+                    remaining -= len(rows)
+                    if remaining <= 0:
+                        return out[:limit]
+                cursor = sub_end
+            return out
+        # Reverse: walk shards top-down, asking for the LAST shard of the
+        # remaining range each step — boundaries that move mid-walk are
+        # re-resolved, so no slice is skipped or split-blind.
+        from ..cluster.shards import GetKeyServerLocationsRequest
+        from ..core.errors import WrongShardServer
+        from ..kv.keys import KeyRange
+        from .load_balance import load_balance
+
+        cur_end = end
+        while cur_end > begin:
+            slices = await self._retrying(
+                lambda: GetKeyServerLocationsRequest(
+                    begin, cur_end, limit=1, reverse=True
+                ),
+                self.location_endpoint, CLIENT_KNOBS.READ_TIMEOUT,
+            )
+            if not slices:
+                break
+            b, e, team = slices[-1]
+            self._locations.insert(KeyRange(b, e), (e, tuple(team)))
+            sub_b = max(b, begin)
+            try:
+                rows = await load_balance(
+                    self.queue_model, self._alternatives(team),
+                    lambda sb=sub_b, ce=cur_end: GetRangeRequest(
+                        sb, ce, version, remaining, True,
+                    ),
+                    self.failure_monitor, self.failure_names,
+                )
+            except WrongShardServer:
+                self._invalidate(sub_b)
+                continue
+            out.extend(rows)
+            if limit:
+                remaining -= len(rows)
+                if remaining <= 0:
+                    return out[:limit]
+            cur_end = sub_b
+        return out
+
+    def watch(self, req: WatchValueRequest):
+        """Watches are LONG-LIVED: routed to one healthy team replica with
+        no deadline and no hedging (the base-class contract; ref:
+        watchValue's single-replica wait, NativeAPI.actor.cpp:1292).
+        wrong_shard_server re-locates and re-registers."""
+
+        async def run():
+            from ..core.errors import WrongShardServer
+
+            while True:
+                _, team = await self._locate(req.key)
+                alts = self._alternatives(team)
+                if self.failure_monitor is not None and self.failure_names:
+                    healthy = [
+                        a for a in alts if not self.failure_monitor.is_failed(
+                            self.failure_names.get(a[0], "")
+                        )
+                    ]
+                    alts = healthy or alts
+                if not alts:
+                    from ..core.errors import RequestMaybeDelivered
+
+                    raise RequestMaybeDelivered("no replicas for watch")
+                inner = WatchValueRequest(req.key, req.value, req.version)
+                alts[0][1].send(inner)
+                try:
+                    return await inner.reply.future
+                except WrongShardServer:
+                    self._invalidate(req.key)
+
+        from ..core.runtime import spawn
+
+        task = spawn(run(), name="watch")
+
+        def forward(f):
+            if req.reply.is_set():
+                return
+            if f.is_error():
+                req.reply.send_error(f._value)
+            else:
+                req.reply.send(f._value)
+
+        task.done.add_callback(forward)
+        return req.reply.future

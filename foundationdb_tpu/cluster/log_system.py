@@ -1,0 +1,171 @@
+"""Tag-partitioned log system (ref:
+fdbserver/TagPartitionedLogSystem.actor.cpp; tags fdbclient/FDBTypes.h:36-67).
+
+Every mutation is stamped at the proxy with the TAGS of the storage
+servers that must apply it (one tag per storage server). `push` (:339)
+routes each mutation to the tlog(s) responsible for its tags —
+`tag % n_logs`, the reference's bestLocationFor — and a commit is durable
+only when EVERY tlog in the generation has made its slice durable (the
+reference waits the full quorum per its replication policy; with one
+copy per tag that is "all logs touched", and every log receives every
+version, empty or not, so each log's (prevVersion -> version] chain stays
+contiguous).
+
+Storage servers peek ONLY their tag (`peek` :362 builds per-tag cursors)
+and pop their tag as they persist (`pop` :458); a log discards a version
+once every tag hosted on it has popped past it.
+
+Recovery: `lock(epoch)` fences all logs and returns the minimum durable
+version — the version the new generation can actually recover everywhere
+(ref: epochEnd :107 computes exactly this from the lock replies).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Sequence
+
+from ..core.actors import all_of
+from ..core.trace import TraceEvent
+from .interfaces import Mutation
+from .tlog import MemoryTLog
+
+
+@dataclass(frozen=True)
+class TaggedMutation:
+    """(ref: the per-mutation tag vector LogPushData writes,
+    MasterProxyServer.actor.cpp phase 3 tag assignment)."""
+
+    tags: tuple  # tuple[int, ...] — destination storage tags
+    mutation: Mutation
+
+
+class TaggedTLog(MemoryTLog):
+    """A MemoryTLog whose entries are TaggedMutation lists, with per-tag
+    peek/pop (ref: TLogServer's per-tag message index, commitMessages :750
+    builds tag->messages; tLogPeekMessages :903; tLogPop :861)."""
+
+    def __init__(self, init_version: int = 0):
+        super().__init__(init_version)
+        self._popped_by_tag: dict[int, int] = {}
+
+    async def peek_tag(self, tag: int, from_version: int):
+        """Durable entries > from_version as (version, [Mutation]) with
+        THIS tag's mutations only. Versions carrying nothing for the tag
+        still appear (empty list): the storage server's version cursor must
+        advance through every version or its reads would block forever."""
+        entries = await self.peek(from_version)
+        return [
+            (
+                v,
+                [tm.mutation for tm in tms if tag in tm.tags],
+            )
+            for v, tms in entries
+        ]
+
+    def pop_tag(self, tag: int, upto_version: int) -> None:
+        """(ref: tLogPop): per-tag acknowledgment; the log discards the
+        prefix every hosted tag has popped."""
+        cur = self._popped_by_tag.get(tag, 0)
+        if upto_version <= cur:
+            return
+        self._popped_by_tag[tag] = upto_version
+        if self._popped_by_tag:
+            self.pop(min(self._popped_by_tag.values()))
+
+
+class TagPartitionedLogSystem:
+    def __init__(self, n_logs: int = 1, init_version: int = 0):
+        assert n_logs >= 1
+        self.logs = [TaggedTLog(init_version) for _ in range(n_logs)]
+        self.locked_epoch = 0
+
+    # -- routing --
+    def log_for_tag(self, tag: int) -> TaggedTLog:
+        """(ref: bestLocationFor — tag-indexed round robin)."""
+        return self.logs[tag % len(self.logs)]
+
+    def tag_view(self, tag: int) -> "TagView":
+        # Registering the tag pins the log's discard horizon at 0 until
+        # this tag's server actually pops — an un-started storage server
+        # must not lose its prefix to other tags' pops.
+        self.log_for_tag(tag)._popped_by_tag.setdefault(tag, 0)
+        return TagView(self, tag)
+
+    # -- the commit path (ref: push :339) --
+    async def push(self, prev_version: int, version: int,
+                   tagged_mutations: Sequence[TaggedMutation],
+                   epoch: int = 0) -> None:
+        per_log: list[list[TaggedMutation]] = [[] for _ in self.logs]
+        for tm in tagged_mutations:
+            for i in {t % len(self.logs) for t in tm.tags}:
+                per_log[i].append(tm)
+        # Every log gets every version (possibly empty) so every chain
+        # advances; durability = all logs durable (the commit's fsync
+        # quorum, ref: TLogCommitReply gathering in push).
+        from ..core.runtime import TaskPriority, spawn
+
+        tasks = [
+            spawn(log.commit(prev_version, version, batch, epoch=epoch),
+                  TaskPriority.TLOG_COMMIT, name=f"logPush{i}")
+            for i, (log, batch) in enumerate(zip(self.logs, per_log))
+        ]
+        await all_of([t.done for t in tasks])
+
+    # -- recovery (ref: epochEnd :107) --
+    def lock(self, epoch: int) -> int:
+        assert epoch >= self.locked_epoch
+        self.locked_epoch = epoch
+        recovery_version = min(log.lock(epoch) for log in self.logs)
+        TraceEvent("LogSystemLocked").detail("Epoch", epoch).detail(
+            "RecoveryVersion", recovery_version
+        ).log()
+        return recovery_version
+
+    @property
+    def version(self):
+        """Highest version received everywhere (min across logs: the
+        version the whole system has seen)."""
+        return min((log.version for log in self.logs),
+                   key=lambda nv: nv.get())
+
+    def durable_version(self) -> int:
+        return min(log.durable.get() for log in self.logs)
+
+    def queue_bytes(self) -> int:
+        """Un-popped payload held across logs (ratekeeper input, ref:
+        TLogQueueInfo)."""
+        total = 0
+        for log in self.logs:
+            for _, tms in log._entries:
+                for tm in tms:
+                    total += len(tm.mutation.param1) + len(tm.mutation.param2)
+        return total
+
+
+class TagView:
+    """The (log_system, tag) cursor a storage server pulls through — the
+    same duck type StorageServer uses on a plain MemoryTLog (ref:
+    LogSystemPeekCursor binding a tag to its serving log set)."""
+
+    def __init__(self, system: TagPartitionedLogSystem, tag: int):
+        self.system = system
+        self.tag = tag
+
+    @property
+    def _log(self) -> TaggedTLog:
+        return self.system.log_for_tag(self.tag)
+
+    @property
+    def version(self):
+        return self._log.version
+
+    @property
+    def durable(self):
+        return self._log.durable
+
+    async def peek(self, from_version: int):
+        return await self._log.peek_tag(self.tag, from_version)
+
+    def pop(self, upto_version: int) -> None:
+        self._log.pop_tag(self.tag, upto_version)

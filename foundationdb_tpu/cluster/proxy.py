@@ -55,7 +55,8 @@ def mutation_write_ranges(m: Mutation) -> KeyRange:
 class CommitProxy:
     def __init__(self, master: Master, resolver: ResolverRole, tlog: MemoryTLog,
                  ratekeeper=None, generation: int = 0,
-                 resolver_endpoint=None, tlog_endpoint=None):
+                 resolver_endpoint=None, tlog_endpoint=None,
+                 log_system=None, shard_map=None):
         self.master = master
         self.resolver = resolver
         self.tlog = tlog
@@ -66,8 +67,16 @@ class CommitProxy:
         # the role code is identical either way, as with FlowTransport.
         self.resolver_endpoint = resolver_endpoint
         self.tlog_endpoint = tlog_endpoint
+        # Sharded tier: mutations are tagged per the shard map and pushed
+        # through the tag-partitioned log system instead of the single
+        # tlog (ref: phase-3 tag assignment + LogPushData,
+        # MasterProxyServer.actor.cpp:414-800).
+        self.log_system = log_system
+        self.shard_map = shard_map
         self.commit_stream: PromiseStream[CommitTransactionRequest] = PromiseStream()
         self.grv_stream: PromiseStream[GetReadVersionRequest] = PromiseStream()
+        # Shard-location service (ref: readRequestServer :1036).
+        self.location_stream: PromiseStream = PromiseStream()
         self._tasks = ActorCollection()
         # Commit statistics, flushed periodically as TraceEvents (ref:
         # ProxyStats, flow/Stats.h:55 CounterCollection).
@@ -115,6 +124,13 @@ class CommitProxy:
             ),
             TaskPriority.GRV, name="grvBatcher",
         ))
+        if self.shard_map is not None:
+            from ..core.actors import serve_requests
+
+            self._tasks.add(serve_requests(
+                self.location_stream, self._serve_locations,
+                TaskPriority.DEFAULT, "proxyLocations",
+            ))
         self.stats.start_logging(5.0)
 
     def stop(self) -> None:
@@ -231,7 +247,38 @@ class CommitProxy:
             )
         return result
 
+    async def _serve_locations(self, req):
+        """(ref: getKeyServersLocations answered from keyServers cache)."""
+        from ..kv.keys import KeyRange
+
+        slices = self.shard_map.intersecting(KeyRange(req.begin, req.end))
+        if getattr(req, "reverse", False):
+            return slices[-req.limit:]
+        return slices[: req.limit]
+
+    def _tag_mutations(self, mutations):
+        from ..kv.atomic import MutationType
+        from ..kv.keys import KeyRange
+        from .log_system import TaggedMutation
+
+        out = []
+        for m in mutations:
+            if m.type == MutationType.CLEAR_RANGE:
+                tags = self.shard_map.tags_for_range(
+                    KeyRange(m.param1, m.param2)
+                )
+            else:
+                tags = self.shard_map.team_for_key(m.param1)
+            out.append(TaggedMutation(tuple(tags), m))
+        return out
+
     async def _tlog_commit(self, prev_version, version, mutations):
+        if self.log_system is not None:
+            await self.log_system.push(
+                prev_version, version, self._tag_mutations(mutations),
+                epoch=self.generation,
+            )
+            return
         if self.tlog_endpoint is not None:
             req = TLogCommitRequest(prev_version, version, tuple(mutations),
                                     epoch=self.generation)

@@ -21,11 +21,25 @@ from ..core.trace import TraceEvent
 class Ratekeeper:
     def __init__(self, tlog, storage):
         self.tlog = tlog
-        self.storage = storage
+        # One storage server or a fleet: the rate follows the WORST lag,
+        # exactly like the reference's worst-queue selection (updateRate's
+        # limiting storage server, Ratekeeper.actor.cpp:310-380).
+        self.storages = list(storage) if isinstance(storage, (list, tuple)) \
+            else [storage]
+        # Tags DD/failure detection declared dead: a failed server's
+        # frozen version must not clamp the cluster's rate forever (the
+        # reference excludes failure-monitor-failed servers from the
+        # limiting computation).
+        self.excluded_tags: set = set()
         self.tps_limit = float("inf")
         self._tokens = 0.0
         self._last_refill = 0.0
         self._task: Task | None = None
+        # Smoothed lag (ref: smoothDurableBytes etc. — Smoother-filtered
+        # queue signals so one slow fsync doesn't slam the rate to zero).
+        from ..core.stats import Smoother
+
+        self._lag = Smoother(e_folding_time=1.0)
         # Control targets (ref: Knobs TARGET_BYTES_PER_STORAGE_SERVER /
         # MAX_VERSION_DIFFERENCE family, restated in version-lag terms).
         self.target_lag_versions = SERVER_KNOBS.STORAGE_DURABILITY_LAG_VERSIONS // 10
@@ -38,9 +52,31 @@ class Ratekeeper:
         if self._task is not None:
             self._task.cancel()
 
+    def set_excluded(self, tags) -> None:
+        self.excluded_tags = set(tags)
+
+    def _live_storages(self):
+        live = [s for s in self.storages
+                if getattr(s, "tag", None) not in self.excluded_tags]
+        return live or self.storages
+
+    def _durable(self) -> int:
+        if hasattr(self.tlog, "durable_version"):
+            return self.tlog.durable_version()
+        return self.tlog.durable.get()
+
     # -- control loop (ref: updateRate) --
     def _compute_rate(self) -> float:
-        lag = self.tlog.durable.get() - self.storage.version.get()
+        raw = self._durable() - min(
+            s.version.get() for s in self._live_storages()
+        )
+        self._lag.set_total(raw)
+        # Smoothing damps transient spikes; a genuinely drained pipeline
+        # lifts the limit immediately (throttling longer than the backlog
+        # exists only hurts).
+        if raw <= self.target_lag_versions:
+            self._lag.reset(raw)
+        lag = self._lag.smooth_total()
         if lag <= self.target_lag_versions:
             return float("inf")
         if lag >= self.max_lag_versions:
@@ -61,7 +97,8 @@ class Ratekeeper:
             if new_rate != self.tps_limit:
                 TraceEvent("RkUpdate").detail("TPSLimit", new_rate).detail(
                     "DurabilityLag",
-                    self.tlog.durable.get() - self.storage.version.get(),
+                    self._durable()
+                    - min(s.version.get() for s in self._live_storages()),
                 ).log()
             self.tps_limit = new_rate
 

@@ -1,0 +1,170 @@
+"""Sharded, replicated one-process cluster: the full data-plane layout
+(ref: SURVEY §2.7 — key-space sharding over storage teams + tag-
+partitioned logging + replica-balanced reads).
+
+Compared to LocalCluster (one storage, one log), this wires:
+
+- a TagPartitionedLogSystem with `n_logs` logs;
+- `n_storage` storage servers, one tag each, each pulling only its tag;
+- a ShardMap assigning each key range a replica TEAM chosen by the
+  replication policy over per-server localities (every mutation is
+  applied by every team member — k-way redundancy like the reference's
+  storage teams, fdbserver/DataDistribution.actor.cpp:486);
+- a proxy that tags mutations per the shard map and serves shard
+  locations to clients;
+- clients that route reads via a location cache and load-balance across
+  each shard's team (client/load_balance.py).
+
+The transaction path (master/resolver/proxy pipeline) is unchanged — the
+whole point of the seam structure.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ..core.rand import DeterministicRandom
+from ..kv.keys import KEYSPACE_END, KeyRange
+from ..resolver.cpu import ConflictSetCPU
+from .log_system import TagPartitionedLogSystem
+from .master import Master
+from .proxy import CommitProxy
+from .ratekeeper import Ratekeeper
+from .replication import LocalityData, Replica, policy_for_mode
+from .resolver_role import ResolverRole
+from .shards import ShardMap
+from .storage import StorageServer
+
+
+class ShardedKVCluster:
+    def __init__(
+        self,
+        n_storage: int = 4,
+        n_logs: int = 2,
+        replication: str = "double",
+        shard_boundaries: Optional[Sequence[bytes]] = None,
+        conflict_set=None,
+        seed: int = 1,
+    ):
+        self.policy = policy_for_mode(replication)
+        self.replicas = [
+            Replica(
+                str(i),
+                LocalityData(
+                    processid=f"p{i}", zoneid=f"z{i}", machineid=f"m{i}",
+                    dcid=f"dc{i % 3}", data_hall=f"h{i % 3}",
+                ),
+            )
+            for i in range(n_storage)
+        ]
+        self.log_system = TagPartitionedLogSystem(n_logs)
+        self.storages = [
+            StorageServer(self.log_system.tag_view(i), 0, tag=i)
+            for i in range(n_storage)
+        ]
+        # -- initial shard layout: boundaries split the keyspace; each
+        #    shard gets a policy-selected team (ref: initial DD teams) --
+        rand = DeterministicRandom(seed)
+        bounds = list(shard_boundaries or [])
+        self.shard_map = ShardMap(default_team=())
+        for s in self.storages:
+            s.owned = _all_false_map()
+            s.assigned = _all_false_map()
+        edges = [b""] + bounds + [KEYSPACE_END]
+        for lo, hi in zip(edges, edges[1:]):
+            sel = self.policy.select_replicas(self.replicas, random=rand)
+            if sel is None:
+                raise ValueError(
+                    f"replication {replication!r} unsatisfiable with "
+                    f"{n_storage} storage servers"
+                )
+            team = tuple(sorted(int(r.id) for r in sel))
+            self.shard_map.set_team(KeyRange(lo, hi), team)
+            for t in team:
+                self.storages[t].set_owned(lo, hi, True)
+                self.storages[t].set_assigned(lo, hi, True)
+
+        self.master = Master(0)
+        self.resolver = ResolverRole(
+            conflict_set if conflict_set is not None else ConflictSetCPU(0), 0
+        )
+        self.ratekeeper = Ratekeeper(self.log_system, self.storages)
+        self.proxy = CommitProxy(
+            self.master, self.resolver, tlog=None,
+            ratekeeper=self.ratekeeper,
+            log_system=self.log_system, shard_map=self.shard_map,
+        )
+        self.dd = None
+        self._started = False
+
+    def start(self) -> "ShardedKVCluster":
+        assert not self._started
+        self._started = True
+        for s in self.storages:
+            s.start()
+        self.ratekeeper.start()
+        self.proxy.start()
+        return self
+
+    def start_data_distribution(self, interval: float = 0.5):
+        """Run the DD role against this cluster (ref: dataDistribution,
+        DataDistribution.actor.cpp:2045)."""
+        from .data_distribution import DataDistributor
+
+        self.dd = DataDistributor(self, interval)
+        self.dd.start()
+        return self.dd
+
+    def stop(self) -> None:
+        if self.dd is not None:
+            self.dd.stop()
+        self.proxy.stop()
+        self.ratekeeper.stop()
+        for s in self.storages:
+            s.stop()
+        self._started = False
+
+    def database(self):
+        from ..client.connection import ShardedConnection
+        from ..client.database import Database
+
+        conn = ShardedConnection(
+            self.proxy.grv_stream,
+            self.proxy.commit_stream,
+            self.proxy.location_stream,
+            {s.tag: s.read_stream for s in self.storages},
+        )
+        return Database(self, conn=conn)
+
+    # -- test/DD hooks --
+    def move_shard(self, r: KeyRange, new_team: Sequence[int]) -> None:
+        """Instant (non-fetching) shard reassignment used by tests; the
+        fetchKeys-style copy lives in MoveKeys (data distribution tier)."""
+        old_teams = {
+            team for _, _, team in self.shard_map.intersecting(r)
+        }
+        new_team = tuple(sorted(new_team))
+        # New members need the data: copy the range at the current applied
+        # version from an old member (MoveKeys' fetchKeys equivalent is
+        # asynchronous; tests use this synchronous stand-in).
+        donor = self.storages[next(iter(old_teams))[0]]
+        rows = donor.data.get_range(r.begin, r.end, donor.version.get())
+        for t in new_team:
+            s = self.storages[t]
+            if t not in {m for team in old_teams for m in team}:
+                for k, v in rows:
+                    s.data.set(k, v, s.version.get())
+            s.set_owned(r.begin, r.end, True)
+            s.set_assigned(r.begin, r.end, True)
+        for team in old_teams:
+            for t in team:
+                if t not in new_team:
+                    self.storages[t].set_owned(r.begin, r.end, False)
+                    self.storages[t].set_assigned(r.begin, r.end, False)
+        self.shard_map.set_team(r, new_team)
+
+
+def _all_false_map():
+    from ..kv.keyrange_map import KeyRangeMap
+
+    return KeyRangeMap(False)

@@ -18,18 +18,39 @@ from ..core.knobs import SERVER_KNOBS
 from ..core.runtime import TaskPriority, buggify, current_loop, spawn
 from ..core.trace import TraceEvent
 from ..kv.atomic import MutationType, apply_atomic
+from ..kv.keys import KeyRange, key_after
 from ..kv.versioned_map import VersionedMap
 from .interfaces import GetRangeRequest, GetValueRequest, Mutation, WatchValueRequest
 from .tlog import MemoryTLog
 
 
 class StorageServer:
-    def __init__(self, tlog: MemoryTLog, init_version: int = 0):
+    def __init__(self, tlog: MemoryTLog, init_version: int = 0,
+                 tag: int | None = None):
         self.tlog = tlog
+        self.tag = tag  # this server's log tag (None = untagged/solo)
         self.data = VersionedMap()
         self.version = NotifiedVersion(init_version)  # applied through here
         self.oldest_version = init_version
         self._watches: list[WatchValueRequest] = []
+        # Shard ownership: reads outside owned ranges answer
+        # wrong_shard_server so clients refresh their location cache (ref:
+        # ShardInfo readable check, storageserver.actor.cpp:87-141).
+        from ..kv.keyrange_map import KeyRangeMap
+
+        self.owned = KeyRangeMap(True)
+        # Assignment: mutations for unassigned ranges are DISCARDED from
+        # the stream (ref: ShardInfo notAssigned shards dropping
+        # mutations, storageserver.actor.cpp:87-141) — an evicted team
+        # member must not resurrect moved data from late union-tagged
+        # commits.
+        self.assigned = KeyRangeMap(True)
+        # Byte-sampled metrics for DD sizing/splitting (ref:
+        # StorageMetrics.actor.h; fed from the apply path like
+        # byteSampleApplySet, storageserver.actor.cpp:2870).
+        from .storage_metrics import StorageServerMetrics
+
+        self.metrics = StorageServerMetrics()
         # Read endpoint (ref: StorageServerInterface.h:31 — getValue,
         # getKeyValues, watchValue request streams served by one role).
         self.read_stream: PromiseStream = PromiseStream()
@@ -87,17 +108,30 @@ class StorageServer:
             self.tlog.pop(self.version.get())
 
     def _apply(self, m: Mutation, version: int) -> None:
+        if m.type == MutationType.CLEAR_RANGE:
+            # Apply only the assigned slices of the cleared range.
+            for b, e, ok in self.assigned.intersecting(
+                KeyRange(m.param1, m.param2)
+            ):
+                if ok:
+                    e2 = e if e is not None else m.param2
+                    self.data.clear_range(b, e2, version)
+                    self.metrics.on_clear_range(b, e2)
+            return
+        if not self.assigned[m.param1]:
+            return
         if m.type == MutationType.SET_VALUE:
             self.data.set(m.param1, m.param2, version)
-        elif m.type == MutationType.CLEAR_RANGE:
-            self.data.clear_range(m.param1, m.param2, version)
+            self.metrics.on_set(m.param1, m.param2)
         else:
             old = self.data.get(m.param1, version)
             new = apply_atomic(m.type, old, m.param2)
             if new is None:
                 self.data.clear(m.param1, version)
+                self.metrics.on_clear_key(m.param1)
             else:
                 self.data.set(m.param1, new, version)
+                self.metrics.on_set(m.param1, new)
 
     def _trigger_watches(self, version: int) -> None:
         if not self._watches:
@@ -127,12 +161,29 @@ class StorageServer:
         if version < self.oldest_version:
             raise TransactionTooOld()
 
+    def set_owned(self, begin: bytes, end: bytes, owned: bool) -> None:
+        self.owned.insert(KeyRange(begin, end), owned)
+
+    def set_assigned(self, begin: bytes, end: bytes, assigned: bool) -> None:
+        self.assigned.insert(KeyRange(begin, end), assigned)
+
+    def _check_owned(self, begin: bytes, end: bytes) -> None:
+        from ..core.errors import WrongShardServer
+
+        for _, _, owned in self.owned.intersecting(KeyRange(begin, end)):
+            if not owned:
+                raise WrongShardServer()
+
     async def get_value(self, req: GetValueRequest) -> Optional[bytes]:
         await self._wait_for_version(req.version)
+        self._check_owned(req.key, key_after(req.key))
+        self.metrics.on_read()
         return self.data.get(req.key, req.version)
 
     async def get_range(self, req: GetRangeRequest):
         await self._wait_for_version(req.version)
+        self._check_owned(req.begin, req.end)
+        self.metrics.on_read()
         return self.data.get_range(
             req.begin, req.end, req.version, req.limit, req.reverse
         )

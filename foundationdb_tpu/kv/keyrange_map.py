@@ -17,9 +17,13 @@ from .keys import KeyRange
 
 
 class KeyRangeMap:
-    def __init__(self, default: Any = None):
+    def __init__(self, default: Any = None, coalesce: bool = True):
+        # coalesce=False keeps explicit boundaries even between equal
+        # values — shard maps need this: adjacent shards may share a team
+        # yet remain distinct shards (ref: keyServers/ boundary entries).
         self._keys: list[bytes] = [b""]
         self._vals: list[Any] = [default]
+        self._coalesce_enabled = coalesce
 
     def __getitem__(self, key: bytes) -> Any:
         return self._vals[bisect_right(self._keys, key) - 1]
@@ -40,6 +44,8 @@ class KeyRangeMap:
         self._coalesce()
 
     def _coalesce(self) -> None:
+        if not self._coalesce_enabled:
+            return
         out_k: list[bytes] = []
         out_v: list[Any] = []
         for k, v in zip(self._keys, self._vals):

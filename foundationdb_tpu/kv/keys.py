@@ -63,3 +63,11 @@ class KeyRange:
 
 def empty_range() -> KeyRange:
     return KeyRange(b"", b"")
+
+
+# Keyspace bounds (ref: allKeys/systemKeys, fdbclient/SystemData.cpp —
+# normal keys live in [b"", b"\xff"), the system keyspace in
+# [b"\xff", b"\xff\xff")).
+ALL_KEYS = KeyRange(b"", b"\xff")
+SYSTEM_KEYS = KeyRange(b"\xff", b"\xff\xff")
+KEYSPACE_END = b"\xff\xff"

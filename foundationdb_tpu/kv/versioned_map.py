@@ -60,6 +60,20 @@ class VersionedMap:
         for key in self.keys_in_range(begin, end):
             self.clear(key, version)
 
+    def set_snapshot(self, key: bytes, value: bytes, version: int) -> None:
+        """Out-of-order base insert for shard fetches (ref: fetchKeys
+        applying a snapshot BENEATH live updates, storageserver.actor.cpp
+        :1761 AddingShard): `value` becomes the authoritative state at
+        `version`, superseding any same-key entries at versions <= it
+        (stream applies the fetch already covers), while entries above it
+        are untouched."""
+        c = self._chain(key)
+        pos = 0
+        while pos < len(c) and c[pos][0] <= version:
+            pos += 1
+        c[:pos] = [(version, value)]
+        self.latest_version = max(self.latest_version, version)
+
     # -- reads --
     def get(self, key: bytes, version: int) -> Optional[bytes]:
         assert version >= self.oldest_version, "read below MVCC window"

@@ -1,0 +1,332 @@
+"""Sharded/replicated data plane tests: tag-partitioned logs, replica
+teams, location-cached + load-balanced reads (ref:
+fdbserver/TagPartitionedLogSystem.actor.cpp, fdbrpc/LoadBalance.actor.h,
+fdbclient/NativeAPI.actor.cpp:1059-1180)."""
+
+import pytest
+
+from foundationdb_tpu.cluster.log_system import (
+    TaggedMutation,
+    TagPartitionedLogSystem,
+)
+from foundationdb_tpu.cluster.sharded_cluster import ShardedKVCluster
+from foundationdb_tpu.cluster.interfaces import Mutation
+from foundationdb_tpu.core import delay
+from foundationdb_tpu.kv.atomic import MutationType
+from foundationdb_tpu.kv.keys import KeyRange
+from foundationdb_tpu.workloads.cycle import CycleWorkload
+
+
+def _set(k: bytes, v: bytes) -> Mutation:
+    return Mutation(MutationType.SET_VALUE, k, v)
+
+
+# ---------------- log system ----------------
+
+def test_tag_routing_and_per_tag_peek(sim):
+    async def main():
+        ls = TagPartitionedLogSystem(n_logs=2)
+        v0, v1 = ls.tag_view(0), ls.tag_view(1)
+        await ls.push(0, 10, [
+            TaggedMutation((0,), _set(b"a", b"1")),
+            TaggedMutation((1,), _set(b"b", b"2")),
+            TaggedMutation((0, 1), _set(b"c", b"3")),
+        ])
+        e0 = await v0.peek(0)
+        e1 = await v1.peek(0)
+        assert [m.param1 for _, ms in e0 for m in ms] == [b"a", b"c"]
+        assert [m.param1 for _, ms in e1 for m in ms] == [b"b", b"c"]
+        # Every log received the version (chains stay contiguous).
+        assert all(log.version.get() == 10 for log in ls.logs)
+        assert ls.durable_version() == 10
+
+    sim.run(main())
+
+
+def test_empty_versions_still_visible_to_every_tag(sim):
+    """A tag with no mutations in a version still sees the version advance
+    — otherwise its storage server's reads would block forever."""
+
+    async def main():
+        ls = TagPartitionedLogSystem(n_logs=2)
+        v1 = ls.tag_view(1)
+        await ls.push(0, 5, [TaggedMutation((0,), _set(b"x", b"y"))])
+        entries = await v1.peek(0)
+        assert entries == [(5, [])]
+
+    sim.run(main())
+
+
+def test_pop_waits_for_all_tags(sim):
+    async def main():
+        ls = TagPartitionedLogSystem(n_logs=1)
+        va, vb = ls.tag_view(0), ls.tag_view(2)  # both on log 0
+        await ls.push(0, 7, [
+            TaggedMutation((0,), _set(b"a", b"1")),
+            TaggedMutation((2,), _set(b"b", b"2")),
+        ])
+        va.pop(7)
+        # Tag 2 hasn't popped: the entry must survive.
+        assert len(ls.logs[0]._entries) == 1
+        vb.pop(7)
+        assert len(ls.logs[0]._entries) == 0
+
+    sim.run(main())
+
+
+def test_log_system_lock_fences_and_reports_min_durable(sim):
+    async def main():
+        ls = TagPartitionedLogSystem(n_logs=2)
+        await ls.push(0, 3, [TaggedMutation((0,), _set(b"k", b"v"))])
+        rv = ls.lock(epoch=1)
+        assert rv == 3
+        from foundationdb_tpu.core.errors import TLogStopped
+
+        with pytest.raises(TLogStopped):
+            await ls.push(3, 4, [], epoch=0)  # old generation fenced
+
+    sim.run(main())
+
+
+# ---------------- sharded cluster end-to-end ----------------
+
+def _cluster(**kw):
+    kw.setdefault("n_storage", 4)
+    kw.setdefault("n_logs", 2)
+    kw.setdefault("replication", "double")
+    kw.setdefault("shard_boundaries", [b"g", b"n", b"t"])
+    return ShardedKVCluster(**kw)
+
+
+def test_sharded_cluster_basic_rw(sim):
+    async def main():
+        c = _cluster().start()
+        db = c.database()
+        for k, v in [(b"apple", b"1"), (b"hat", b"2"), (b"pear", b"3"),
+                     (b"zebra", b"4")]:
+            await db.set(k, v)
+        for k, v in [(b"apple", b"1"), (b"hat", b"2"), (b"pear", b"3"),
+                     (b"zebra", b"4")]:
+            assert await db.get(k) == v
+        # Cross-shard range read stitches shards in order.
+        async def body(tr):
+            return await tr.get_range(b"", b"\xff")
+
+        rows = await db.transact(body)
+        assert [k for k, _ in rows] == [b"apple", b"hat", b"pear", b"zebra"]
+        c.stop()
+
+    sim.run(main())
+
+
+def test_mutations_only_reach_team_members(sim):
+    async def main():
+        c = _cluster().start()
+        db = c.database()
+        await db.set(b"apple", b"1")
+        await delay(1.0)
+        team = c.shard_map.team_for_key(b"apple")
+        assert len(team) == 2  # double replication
+        for s in c.storages:
+            have = s.data.get(b"apple", s.version.get())
+            if s.tag in team:
+                assert have == b"1", f"replica {s.tag} missing the write"
+            else:
+                assert have is None, f"non-member {s.tag} got the write"
+        c.stop()
+
+    sim.run(main())
+
+
+def test_replicas_converge_identically(sim):
+    """ConsistencyCheck's core property: all replicas of a shard hold the
+    same data at a settled version (ref:
+    fdbserver/workloads/ConsistencyCheck.actor.cpp)."""
+
+    async def main():
+        c = _cluster().start()
+        db = c.database()
+        wl = CycleWorkload(db, nodes=24)
+        await wl.setup()
+        await wl.start(clients=4, txns_per_client=15)
+        assert await wl.check()
+        await delay(1.0)  # let every replica drain its tag
+        for begin, end, team in c.shard_map.ranges():
+            if not team:
+                continue
+            end = end if end is not None else b"\xff\xff"
+            views = []
+            for t in team:
+                s = c.storages[t]
+                views.append(s.data.get_range(begin, end, s.version.get()))
+            assert all(v == views[0] for v in views[1:]), (
+                f"replica divergence in [{begin!r}, {end!r})"
+            )
+        c.stop()
+
+    sim.run(main())
+
+
+def test_stale_location_cache_recovers_via_wrong_shard_server(sim):
+    async def main():
+        c = _cluster().start()
+        db = c.database()
+        await db.set(b"apple", b"1")
+        assert await db.get(b"apple") == b"1"  # cache now warm
+        # Move the shard to a different team behind the client's back.
+        old_team = set(c.shard_map.team_for_key(b"apple"))
+        new_team = [t for t in range(4) if t not in old_team][:2]
+        assert len(new_team) == 2
+        c.move_shard(KeyRange(b"", b"g"), new_team)
+        # Stale cache -> wrong_shard_server -> invalidate -> re-locate.
+        assert await db.get(b"apple") == b"1"
+        assert await db.get(b"banana") is None
+        c.stop()
+
+    sim.run(main())
+
+
+def test_triple_replication_layout(sim):
+    async def main():
+        c = _cluster(replication="triple", n_storage=5).start()
+        db = c.database()
+        await db.set(b"k", b"v")
+        await delay(0.5)
+        team = c.shard_map.team_for_key(b"k")
+        assert len(team) == 3
+        assert await db.get(b"k") == b"v"
+        c.stop()
+
+    sim.run(main())
+
+
+# ---------------- load balance ----------------
+
+def test_load_balance_hedges_to_healthy_replica(sim):
+    """A silent replica must not stall reads: the hedge fires the backup
+    request (ref: LoadBalance.actor.h:289 second-request logic)."""
+    from foundationdb_tpu.client.load_balance import QueueModel, load_balance
+    from foundationdb_tpu.cluster.interfaces import GetValueRequest
+
+    class DeadEndpoint:
+        def send(self, req):
+            pass  # drops everything
+
+    class LiveEndpoint:
+        def __init__(self):
+            self.hits = 0
+
+        def send(self, req):
+            self.hits += 1
+            req.reply.send(b"value")
+
+    async def main():
+        qm = QueueModel()
+        dead, live = DeadEndpoint(), LiveEndpoint()
+        result = await load_balance(
+            qm, [("dead", dead), ("live", live)],
+            lambda: GetValueRequest(b"k", 1),
+        )
+        assert result == b"value"
+        assert live.hits == 1
+        # Losing a hedge race is NOT a failure signal: the silent replica
+        # only stops counting as outstanding (full-timeout silence is what
+        # marks failure).
+        assert qm.model("dead").failed_until == 0
+        assert qm.model("dead").outstanding == 0
+
+    sim.run(main())
+
+
+def test_load_balance_prefers_low_latency_replica(sim):
+    from foundationdb_tpu.client.load_balance import QueueModel, load_balance
+    from foundationdb_tpu.cluster.interfaces import GetValueRequest
+    from foundationdb_tpu.core.runtime import spawn
+
+    class SlowEndpoint:
+        def __init__(self, d):
+            self.d = d
+            self.hits = 0
+
+        def send(self, req):
+            self.hits += 1
+
+            async def answer():
+                await delay(self.d)
+                if not req.reply.is_set():
+                    req.reply.send(b"v")
+
+            spawn(answer())
+
+    async def main():
+        qm = QueueModel()
+        fast, slow = SlowEndpoint(0.001), SlowEndpoint(0.2)
+        for _ in range(30):
+            await load_balance(
+                qm, [("fast", fast), ("slow", slow)],
+                lambda: GetValueRequest(b"k", 1),
+            )
+        # Warm model: the fast replica should dominate.
+        assert fast.hits > slow.hits
+
+    sim.run(main())
+
+
+def test_cross_shard_reverse_range_and_limits(sim):
+    async def main():
+        c = _cluster().start()
+        db = c.database()
+        keys = [b"apple", b"hat", b"pear", b"zebra"]
+        for i, k in enumerate(keys):
+            await db.set(k, b"%d" % i)
+
+        async def rev(tr):
+            return await tr.get_range(b"", b"\xff", reverse=True)
+
+        rows = await db.transact(rev)
+        assert [k for k, _ in rows] == list(reversed(keys))
+
+        async def rev2(tr):
+            return await tr.get_range(b"", b"\xff", limit=2, reverse=True)
+
+        rows = await db.transact(rev2)
+        assert [k for k, _ in rows] == [b"zebra", b"pear"]
+
+        async def fwd2(tr):
+            return await tr.get_range(b"", b"\xff", limit=3)
+
+        rows = await db.transact(fwd2)
+        assert [k for k, _ in rows] == [b"apple", b"hat", b"pear"]
+        c.stop()
+
+    sim.run(main())
+
+
+def test_watch_on_sharded_cluster_is_long_lived(sim):
+    """A sharded watch must survive well past READ_TIMEOUT and fire on the
+    actual change (the base-class no-deadline contract)."""
+
+    async def main():
+        from foundationdb_tpu.core import spawn
+
+        c = _cluster().start()
+        db = c.database()
+        await db.set(b"watched", b"v0")
+
+        async def watcher():
+            tr = db.create_transaction()
+            v = await tr.get(b"watched")
+            assert v == b"v0"
+            fut = tr.watch(b"watched")
+            await tr.commit()
+            await fut.wait()
+            return "fired"
+
+        w = spawn(watcher())
+        await delay(8.0)  # > READ_TIMEOUT: watch must still be pending
+        assert not w.done.is_ready()
+        await db.set(b"watched", b"v1")
+        assert await w.done == "fired"
+        c.stop()
+
+    sim.run(main())
