@@ -1,0 +1,84 @@
+"""Byte-sample storage metrics tests (ref: fdbserver/StorageMetrics.actor.h,
+storageserver.actor.cpp:2870 byte sampling)."""
+
+import pytest
+
+from foundationdb_tpu.cluster.storage_metrics import ByteSample, StorageServerMetrics
+from foundationdb_tpu.core.knobs import SERVER_KNOBS
+from foundationdb_tpu.kv.keys import KeyRange
+
+
+def _fill(sample: ByteSample, n: int, value_bytes: int, prefix=b"k"):
+    for i in range(n):
+        key = prefix + b"%06d" % i
+        sample.entry_set(key, len(key) + value_bytes)
+
+
+def test_estimate_tracks_true_bytes_within_tolerance():
+    s = ByteSample()
+    n, vbytes = 20000, 100
+    _fill(s, n, vbytes)
+    true_total = sum(len(b"k%06d" % i) + vbytes for i in range(n))
+    overhead = n * SERVER_KNOBS.BYTE_SAMPLING_OVERHEAD
+    est = s.bytes_in_range(KeyRange(b"", b"\xff"))
+    # Unbiased estimator with ~sqrt(sample) noise: 25% tolerance is lax
+    # but deterministic (hash-based inclusion).
+    assert abs(est - (true_total + overhead)) / (true_total + overhead) < 0.25
+
+
+def test_range_scoping_and_clear():
+    s = ByteSample()
+    _fill(s, 5000, 50, prefix=b"a/")
+    _fill(s, 5000, 50, prefix=b"b/")
+    whole = s.bytes_in_range(KeyRange(b"", b"\xff"))
+    a = s.bytes_in_range(KeyRange(b"a/", b"a0"))
+    b = s.bytes_in_range(KeyRange(b"b/", b"b0"))
+    assert whole == pytest.approx(a + b)
+    assert a == pytest.approx(b, rel=0.4)
+    s.entry_clear_range(b"a/", b"a0")
+    assert s.bytes_in_range(KeyRange(b"a/", b"a0")) == 0
+    assert s.bytes_in_range(KeyRange(b"", b"\xff")) == pytest.approx(b)
+    assert s.total == pytest.approx(b)
+
+
+def test_set_overwrite_replaces_weight():
+    s = ByteSample()
+    s.entry_set(b"k", 100000)  # big enough to always sample
+    w1 = s.total
+    assert w1 > 0
+    s.entry_set(b"k", 200000)
+    assert s.total > w1
+    s.entry_clear_key(b"k")
+    assert s.total == 0
+
+
+def test_split_points_balance():
+    s = ByteSample()
+    _fill(s, 20000, 100)
+    r = KeyRange(b"", b"\xff")
+    total = s.bytes_in_range(r)
+    points = s.split_points(r, total / 4)
+    assert 3 <= len(points) <= 4
+    # Chunks between consecutive points are ~balanced.
+    edges = [b""] + points + [b"\xff"]
+    chunks = [s.bytes_in_range(KeyRange(lo, hi))
+              for lo, hi in zip(edges, edges[1:])]
+    for c in chunks[:-1]:
+        assert c == pytest.approx(total / 4, rel=0.3)
+    # Points are sorted keys inside the range.
+    assert points == sorted(points)
+
+
+def test_metrics_surface_smoothers(sim):
+    async def main():
+        from foundationdb_tpu.core import delay
+
+        m = StorageServerMetrics()
+        for i in range(100):
+            m.on_set(b"k%03d" % i, b"x" * 1000)
+        await delay(1.0)
+        assert m.write_bandwidth() > 0
+        m.on_read()
+        assert m.shard_bytes(KeyRange(b"", b"\xff")) > 0
+
+    sim.run(main())
