@@ -78,7 +78,83 @@ class _WriteEntry:
 class Transaction:
     def __init__(self, db):
         self._db = db
+        # Options survive on_error retries but not reset() (ref: onError
+        # preserves options; the codegen'd setters are
+        # tools/vexillographer.py's output).
+        from ..options import TransactionOptions
+
+        self.options = TransactionOptions(self)
+        self._option_values: dict[int, Optional[int]] = dict(
+            getattr(db, "default_transaction_options", {})
+        )
+        self._deadline: Optional[float] = None
+        self._retries_left: Optional[int] = None
         self._reset()
+        self._apply_options()
+
+    def _set_option(self, code: int, value: Optional[int]) -> None:
+        from ..options import TransactionOptions as TO
+
+        self._option_values[code] = value
+        # Side effects fire ONLY for the option being set: re-setting an
+        # unrelated option must not extend the deadline or refill the
+        # retry budget (db.transact bodies re-run per attempt and may set
+        # flags like access_system_keys every time).
+        if code == TO.TIMEOUT and value is not None:
+            self._deadline = current_loop().now() + value / 1000.0
+        elif code == TO.RETRY_LIMIT and value is not None:
+            self._retries_left = None if value < 0 else value
+
+    def _apply_options(self) -> None:
+        """Apply every stored option's side effects (constructor only,
+        for database-level defaults)."""
+        for code, value in list(self._option_values.items()):
+            self._set_option(code, value)
+
+    def _option(self, code: int) -> bool:
+        return code in self._option_values
+
+    def _check_deadline(self) -> None:
+        if self._deadline is not None and current_loop().now() > self._deadline:
+            from ..core.errors import TransactionTimedOut
+
+            raise TransactionTimedOut()
+
+    def _ryw_enabled(self, snapshot: bool) -> bool:
+        from ..options import TransactionOptions as TO
+
+        if self._option(TO.READ_YOUR_WRITES_DISABLE):
+            return False
+        if snapshot and self._option(TO.SNAPSHOT_RYW_DISABLE):
+            return False
+        return True
+
+    def _check_system_access(self, key: bytes, write: bool) -> None:
+        """(ref: key_outside_legal_range unless ACCESS_SYSTEM_KEYS /
+        READ_SYSTEM_KEYS is set, NativeAPI's validateKey)."""
+        if not key.startswith(b"\xff"):
+            return
+        self._require_system_option(write)
+
+    def _check_system_range(self, begin: bytes, end: bytes, write: bool
+                            ) -> None:
+        """A range [begin, end) touches system keys iff any part of it is
+        at or above \\xff — checking only `begin` would let
+        clear_range(b'z', b'\\xff\\xff') wipe the system space."""
+        if end > b"\xff" and end > begin:
+            self._require_system_option(write)
+
+    def _require_system_option(self, write: bool) -> None:
+        from ..core.errors import KeyOutsideLegalRange
+        from ..options import TransactionOptions as TO
+
+        if self._option(TO.ACCESS_SYSTEM_KEYS):
+            return
+        if not write and self._option(TO.READ_SYSTEM_KEYS):
+            return
+        raise KeyOutsideLegalRange(
+            "system-key access requires the access_system_keys option"
+        )
 
     def _reset(self):
         # Watches from an abandoned attempt must not hang their waiters:
@@ -104,6 +180,10 @@ class Transaction:
         self._cancelled = False
         self._backoff = CLIENT_KNOBS.DEFAULT_BACKOFF
         self._watch_list: list = []
+        for p in getattr(self, "_versionstamp_promises", []):
+            if not p.is_set():
+                p.send_error(TransactionCancelled())
+        self._versionstamp_promises: list = []
 
     # -- versions --
     def get_read_version(self) -> Future:
@@ -143,7 +223,14 @@ class Transaction:
     # -- reads --
     async def get(self, key: bytes, snapshot: bool = False) -> Optional[bytes]:
         self._check_usable()
+        self._check_deadline()
         self._check_key(key)
+        self._check_system_access(key, write=False)
+        if not self._ryw_enabled(snapshot):
+            version = await self.get_read_version()
+            if not snapshot:
+                self._read_conflicts.append(KeyRange(key, key_after(key)))
+            return await self._db.conn.get_value(key, version)
         entry = self._writes.get(key)
         if entry is not None and entry.known:
             return entry.value
@@ -169,13 +256,17 @@ class Transaction:
         snapshot: bool = False,
     ) -> list[tuple[bytes, bytes]]:
         self._check_usable()
+        self._check_deadline()
         self._check_key(begin)
         self._check_key(end, is_end=True)
+        self._check_system_access(begin, write=False)
+        self._check_system_range(begin, end, write=False)
         if begin > end:
             raise InvertedRange()
         version = await self.get_read_version()
-        overlay = any(begin <= k < end for k in self._writes) or any(
-            c.intersects(KeyRange(begin, end)) for c in self._clears
+        overlay = self._ryw_enabled(snapshot) and (
+            any(begin <= k < end for k in self._writes)
+            or any(c.intersects(KeyRange(begin, end)) for c in self._clears)
         )
         if not overlay:
             # Fast path: no local writes in range — the storage scan can be
@@ -237,6 +328,7 @@ class Transaction:
     def set(self, key: bytes, value: bytes) -> None:
         self._check_usable()
         self._check_key(key)
+        self._check_system_access(key, write=True)
         if len(value) > CLIENT_KNOBS.VALUE_SIZE_LIMIT:
             raise ValueTooLarge(f"value of {len(value)} bytes")
         self._log(Mutation(MutationType.SET_VALUE, key, value))
@@ -249,6 +341,8 @@ class Transaction:
         self._check_usable()
         self._check_key(begin)
         self._check_key(end, is_end=True)
+        self._check_system_access(begin, write=True)
+        self._check_system_range(begin, end, write=True)
         if begin > end:
             raise InvertedRange()
         if begin == end:
@@ -261,6 +355,7 @@ class Transaction:
     def atomic_op(self, op: MutationType, key: bytes, param: bytes) -> None:
         self._check_usable()
         self._check_key(key)
+        self._check_system_access(key, write=True)
         if op in (MutationType.SET_VALUE, MutationType.CLEAR_RANGE):
             raise ValueError("use set()/clear_range() for plain mutations")
         self._log(Mutation(op, key, param))
@@ -273,6 +368,65 @@ class Transaction:
 
     def add(self, key: bytes, param: bytes) -> None:
         self.atomic_op(MutationType.ADD_VALUE, key, param)
+
+    # -- versionstamped operations (ref: SET_VERSIONSTAMPED_KEY/VALUE,
+    #    CommitTransaction.h:31; bindings' 4-byte-LE-offset convention) --
+    @staticmethod
+    def _check_stamp_param(param: bytes) -> bytes:
+        """Validate the 4-byte-LE-offset convention CLIENT-side: a bad
+        offset must fail this one transaction, never reach the proxy's
+        shared commit batch (ref: client_invalid_operation on malformed
+        versionstamp params). Returns the body (param without suffix)."""
+        import struct as _struct
+
+        from ..kv.atomic import VERSIONSTAMP_BYTES
+
+        if len(param) < 4:
+            raise ValueError("versionstamped parameter lacks offset suffix")
+        (offset,) = _struct.unpack("<I", param[-4:])
+        body = param[:-4]
+        if offset + VERSIONSTAMP_BYTES > len(body):
+            raise ValueError(
+                f"versionstamp offset {offset} out of range for "
+                f"{len(body)}-byte parameter"
+            )
+        return body
+
+    def set_versionstamped_key(self, key: bytes, value: bytes) -> None:
+        """`key` = placeholder bytes with a trailing 4-byte little-endian
+        offset of the 10-byte stamp position; the final key materializes
+        at commit. The mutation's own write range (placeholder form)
+        participates in conflict detection; the materialized key is
+        globally unique so no other writer can collide with it."""
+        self._check_usable()
+        body = self._check_stamp_param(key)
+        self._check_key(body)  # materialized key has the body's length
+        self._check_system_access(body, write=True)
+        if len(value) > CLIENT_KNOBS.VALUE_SIZE_LIMIT:
+            raise ValueTooLarge(f"value of {len(value)} bytes")
+        self._log(Mutation(MutationType.SET_VERSIONSTAMPED_KEY, key, value))
+
+    def set_versionstamped_value(self, key: bytes, value: bytes) -> None:
+        """`value` carries the offset suffix; RYW reads of `key` before
+        commit observe the PLACEHOLDER (the stamp does not exist yet)."""
+        self._check_usable()
+        self._check_key(key)
+        self._check_system_access(key, write=True)
+        body = self._check_stamp_param(value)
+        if len(body) > CLIENT_KNOBS.VALUE_SIZE_LIMIT:
+            raise ValueTooLarge(f"value of {len(body)} bytes")
+        self._log(Mutation(MutationType.SET_VERSIONSTAMPED_VALUE, key, value))
+        self._entry(key).set(body)
+
+    def get_versionstamp(self) -> "Future":
+        """Future of the 10-byte stamp this transaction's versionstamped
+        operations used; resolves after commit (ref:
+        Transaction::getVersionstamp, NativeAPI.actor.cpp)."""
+        from ..core.runtime import Promise
+
+        p = Promise()
+        self._versionstamp_promises.append(p)
+        return p.future
 
     # -- conflict ranges (ref: tr.add_read/write_conflict_range) --
     def add_read_conflict_range(self, begin: bytes, end: bytes) -> None:
@@ -307,15 +461,22 @@ class Transaction:
         """Resolves with the commit version; raises NotCommitted on
         conflict (ref: Transaction::commit :2571)."""
         self._check_usable()
+        self._check_deadline()
         if self._committed_version is not None:
             return self._committed_version
         if not self._mutation_log and not self._extra_write_conflicts:
             # Read-only transactions commit trivially at their snapshot
-            # (ref: tryCommit fast path).
+            # (ref: tryCommit fast path). A read-only commit has no
+            # versionstamp (ref: no_commit_version from getVersionstamp).
             rv = 0
             if self._read_version_f is not None:
                 rv = await self._read_version_f
             self._committed_version = rv
+            from ..core.errors import NoCommitVersion
+
+            for p in self._versionstamp_promises:
+                if not p.is_set():
+                    p.send_error(NoCommitVersion())
             await self._arm_watches(rv)
             return rv
         snapshot = 0
@@ -333,6 +494,9 @@ class Transaction:
         finally:
             self._commit_outstanding = False
         self._committed_version = commit_id.version
+        for p in self._versionstamp_promises:
+            if not p.is_set():
+                p.send(commit_id.versionstamp)
         await self._arm_watches(commit_id.version)
         return commit_id.version
 
@@ -350,20 +514,32 @@ class Transaction:
         self._watch_list = []
 
     async def on_error(self, err: BaseException) -> None:
-        """Backoff-and-reset for retryable errors, re-raise otherwise
-        (ref: Transaction::onError :2796)."""
+        """Backoff-and-reset for retryable errors, re-raise otherwise;
+        honors the retry_limit / max_retry_delay / timeout options (ref:
+        Transaction::onError :2796 with the option checks)."""
         if not is_retryable(err):
             raise err
+        if self._retries_left is not None:
+            if self._retries_left <= 0:
+                raise err
+            self._retries_left -= 1
+        self._check_deadline()
         loop = current_loop()
         backoff = self._backoff
         self._reset_for_retry(backoff)
         await loop.delay(backoff * (0.5 + loop.random.random01()))
 
     def _reset_for_retry(self, prev_backoff: float) -> None:
+        from ..options import TransactionOptions as TO
+
+        retries_left = self._retries_left
         self._reset()
+        self._retries_left = retries_left
+        max_backoff = CLIENT_KNOBS.DEFAULT_MAX_BACKOFF
+        if self._option_values.get(TO.MAX_RETRY_DELAY) is not None:
+            max_backoff = self._option_values[TO.MAX_RETRY_DELAY] / 1000.0
         self._backoff = min(
-            prev_backoff * CLIENT_KNOBS.BACKOFF_GROWTH_RATE,
-            CLIENT_KNOBS.DEFAULT_MAX_BACKOFF,
+            prev_backoff * CLIENT_KNOBS.BACKOFF_GROWTH_RATE, max_backoff
         )
 
     def reset(self) -> None:

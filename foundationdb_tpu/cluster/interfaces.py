@@ -49,9 +49,12 @@ class CommitTransactionRequest:
 
 @dataclass
 class CommitID:
-    """(ref: CommitID, MasterProxyInterface.h:60)."""
+    """(ref: CommitID, MasterProxyInterface.h:60; the versionstamp is the
+    10-byte (version, batch_index) stamp spliced into this transaction's
+    versionstamped operations)."""
 
     version: int
+    versionstamp: bytes = b""
 
 
 @dataclass

@@ -73,6 +73,13 @@ class CommitProxy:
         # MasterProxyServer.actor.cpp:414-800).
         self.log_system = log_system
         self.shard_map = shard_map
+        # Committed mutations on \xff keys are interpreted here, exactly
+        # like applyMetadataMutations updating the proxy's caches (ref:
+        # fdbserver/ApplyMetadataMutation.h; called from commitBatch
+        # phase 3, MasterProxyServer.actor.cpp:449).
+        self.metadata_hook = None
+        # Extra log tags every mutation is shipped to (DR subscribers).
+        self.dr_tags: tuple = ()
         self.commit_stream: PromiseStream[CommitTransactionRequest] = PromiseStream()
         self.grv_stream: PromiseStream[GetReadVersionRequest] = PromiseStream()
         # Shard-location service (ref: readRequestServer :1036).
@@ -269,7 +276,12 @@ class CommitProxy:
                 )
             else:
                 tags = self.shard_map.team_for_key(m.param1)
-            out.append(TaggedMutation(tuple(tags), m))
+            # Extra subscriber tags (DR/backup log shipping): every
+            # mutation also reaches these cursors (ref: backup workers
+            # pulling dedicated tags; the v6.0 mechanism writes \xff/blog
+            # via the proxy — tag subscription is the same architecture
+            # on the tag-partitioned log).
+            out.append(TaggedMutation(tuple(tags) + tuple(self.dr_tags), m))
         return out
 
     async def _tlog_commit(self, prev_version, version, mutations):
@@ -296,6 +308,39 @@ class CommitProxy:
             "Txns", len(reqs)
         ).log()
 
+        # Versionstamp substitution: the version is known as of phase 1,
+        # so SET_VERSIONSTAMPED_* become plain sets BEFORE resolution —
+        # conflict ranges, tags, and the log all see final keys (ref: the
+        # proxy's transformation, commitBatch phase 3; batch index is the
+        # txn's position, MasterProxyInterface.h CommitID.batchIndex).
+        from ..kv.atomic import (
+            MutationType,
+            pack_versionstamp,
+            transform_versionstamp_mutation,
+        )
+
+        stamps = []
+        for idx, r in enumerate(reqs):
+            stamp = pack_versionstamp(version, idx)
+            stamps.append(stamp)
+            if any(m.type in (MutationType.SET_VERSIONSTAMPED_KEY,
+                              MutationType.SET_VERSIONSTAMPED_VALUE)
+                   for m in r.mutations):
+                try:
+                    r.mutations = tuple(
+                        transform_versionstamp_mutation(m, stamp)
+                        for m in r.mutations
+                    )
+                except ValueError as e:
+                    # A malformed stamp offset fails ITS transaction, not
+                    # the shared batch (clients validate; this is the
+                    # server-side backstop against hostile payloads).
+                    if not r.reply.is_set():
+                        r.reply.send_error(OperationFailed(str(e)))
+                    r.mutations = ()
+                    r.read_conflict_ranges = ()
+                    r.write_conflict_ranges = ()
+
         # Phase 2: resolution.
         txns = [
             TxnConflictInfo(
@@ -319,11 +364,16 @@ class CommitProxy:
         else:
             result = await self.resolver.resolve_batch(resolve_req)
 
-        # Phase 3: merge verdicts, build the log payload.
+        # Phase 3: merge verdicts, build the log payload; interpret
+        # committed system-keyspace mutations (ApplyMetadataMutation).
         mutations = []
         for r, status in zip(reqs, result.statuses):
             if status == COMMITTED:
                 mutations.extend(r.mutations)
+                if self.metadata_hook is not None:
+                    for m in r.mutations:
+                        if m.param1.startswith(b"\xff"):
+                            self.metadata_hook(m)
         if buggify("proxy_commit_delay"):
             await loop.delay(0.05 * loop.random.random01())
 
@@ -332,12 +382,12 @@ class CommitProxy:
 
         # Phase 5: advance committed version, answer clients.
         self.master.report_committed(version)
-        for r, status in zip(reqs, result.statuses):
+        for idx, (r, status) in enumerate(zip(reqs, result.statuses)):
             if r.reply.is_set():
                 continue
             if status == COMMITTED:
                 self._c_committed.add(1)
-                r.reply.send(CommitID(version))
+                r.reply.send(CommitID(version, stamps[idx]))
             elif status == TOO_OLD:
                 self._c_too_old.add(1)
                 r.reply.send_error(TransactionTooOld())

@@ -56,6 +56,9 @@ ValueTooLarge = _define("ValueTooLarge", 2103, "Value length exceeds limit")
 TransactionCancelled = _define("TransactionCancelled", 1025, "Operation aborted because the transaction was cancelled")
 UsedDuringCommit = _define("UsedDuringCommit", 2017, "Operation issued while a commit was outstanding")
 InvertedRange = _define("InvertedRange", 2005, "Range begin key exceeds end key")
+KeyOutsideLegalRange = _define("KeyOutsideLegalRange", 2003, "Key outside legal range (system keys need access_system_keys)")
+NoCommitVersion = _define("NoCommitVersion", 2021, "Read-only transaction has no commit version or versionstamp")
+TransactionTimedOut = _define("TransactionTimedOut", 1031, "Operation aborted because the transaction timed out")
 
 # Cluster / role errors.
 OperationFailed = _define("OperationFailed", 1000, "Operation failed")

@@ -27,6 +27,11 @@ class MutationType(IntEnum):
     MIN = 9
     BYTE_MIN = 12
     BYTE_MAX = 13
+    # Substituted with (commit_version, batch_index) proxy-side before
+    # resolution/logging (ref: SetVersionstampedKey/Value,
+    # CommitTransaction.h:31; transformed in commitBatch phase 3).
+    SET_VERSIONSTAMPED_KEY = 14
+    SET_VERSIONSTAMPED_VALUE = 15
 
 
 def _le_to_int(b: bytes) -> int:
@@ -90,3 +95,44 @@ def apply_atomic(
             return param
         return max(existing, param)
     raise ValueError(f"unknown atomic op {op}")
+
+
+# -- versionstamps (ref: fdbclient/Atomic.h placeVersionstamp /
+#    transformVersionstampMutation) --
+
+VERSIONSTAMP_BYTES = 10  # 8-byte big-endian version + 2-byte batch index
+
+
+def pack_versionstamp(version: int, batch_index: int) -> bytes:
+    import struct
+
+    return struct.pack(">QH", version, batch_index)
+
+
+def place_versionstamp(param: bytes, stamp: bytes) -> bytes:
+    """Splice `stamp` into `param` at the position named by its 4-byte
+    little-endian offset suffix (the bindings' versionstamp convention,
+    api version >= 520), returning param without the suffix."""
+    import struct
+
+    if len(param) < 4:
+        raise ValueError("versionstamped parameter lacks offset suffix")
+    (offset,) = struct.unpack("<I", param[-4:])
+    body = param[:-4]
+    if offset + VERSIONSTAMP_BYTES > len(body):
+        raise ValueError(
+            f"versionstamp offset {offset} out of range for {len(body)}-byte parameter"
+        )
+    return body[:offset] + stamp + body[offset + VERSIONSTAMP_BYTES:]
+
+
+def transform_versionstamp_mutation(m, stamp: bytes):
+    """SET_VERSIONSTAMPED_* -> plain SET_VALUE with the stamp spliced in
+    (ref: the proxy's transformation before resolution/logging)."""
+    if m.type == MutationType.SET_VERSIONSTAMPED_KEY:
+        return type(m)(MutationType.SET_VALUE,
+                       place_versionstamp(m.param1, stamp), m.param2)
+    if m.type == MutationType.SET_VERSIONSTAMPED_VALUE:
+        return type(m)(MutationType.SET_VALUE, m.param1,
+                       place_versionstamp(m.param2, stamp))
+    return m

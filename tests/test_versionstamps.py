@@ -1,0 +1,158 @@
+"""Versionstamp tests (ref: SetVersionstampedKey/Value,
+fdbclient/CommitTransaction.h:31, Atomic.h placeVersionstamp)."""
+
+import struct
+
+import pytest
+
+from foundationdb_tpu.cluster.cluster import LocalCluster
+from foundationdb_tpu.kv.atomic import (
+    MutationType,
+    pack_versionstamp,
+    place_versionstamp,
+)
+
+
+def _stamp_key(prefix: bytes, suffix: bytes = b"") -> bytes:
+    """prefix + 10-byte placeholder + suffix + LE offset of placeholder."""
+    return (
+        prefix + b"\x00" * 10 + suffix + struct.pack("<I", len(prefix))
+    )
+
+
+def test_place_versionstamp():
+    stamp = pack_versionstamp(1234, 7)
+    assert len(stamp) == 10
+    out = place_versionstamp(_stamp_key(b"pfx/", b"/tail"), stamp)
+    assert out == b"pfx/" + stamp + b"/tail"
+    with pytest.raises(ValueError):
+        place_versionstamp(b"\x01\x02", stamp)  # no offset suffix
+    with pytest.raises(ValueError):
+        place_versionstamp(b"ab" + struct.pack("<I", 1), stamp)  # oob
+
+
+def test_versionstamped_key_materializes_and_orders(sim):
+    async def main():
+        c = LocalCluster().start()
+        db = c.database()
+        stamps = []
+        for i in range(3):
+            tr = db.create_transaction()
+            tr.set_versionstamped_key(_stamp_key(b"log/"), b"v%d" % i)
+            vs_f = tr.get_versionstamp()
+            v = await tr.commit()
+            stamp = await vs_f
+            assert len(stamp) == 10
+            assert struct.unpack(">Q", stamp[:8])[0] == v
+            stamps.append(stamp)
+
+        # Stamps strictly increase -> keys are append-ordered.
+        assert stamps == sorted(stamps)
+        rows = await db.transact(
+            lambda tr: tr.get_range(b"log/", b"log0")
+        )
+        assert [v for _, v in rows] == [b"v0", b"v1", b"v2"]
+        assert [k for k, _ in rows] == [b"log/" + s for s in stamps]
+        c.stop()
+
+    sim.run(main())
+
+
+def test_versionstamped_value(sim):
+    async def main():
+        c = LocalCluster().start()
+        db = c.database()
+        tr = db.create_transaction()
+        placeholder = b"id=" + b"\x00" * 10 + struct.pack("<I", 3)
+        tr.set_versionstamped_value(b"doc", placeholder)
+        # RYW before commit shows the placeholder body.
+        assert await tr.get(b"doc") == b"id=" + b"\x00" * 10
+        vs_f = tr.get_versionstamp()
+        await tr.commit()
+        stamp = await vs_f
+        assert await db.get(b"doc") == b"id=" + stamp
+        c.stop()
+
+    sim.run(main())
+
+
+def test_two_versionstamps_same_batch_differ(sim):
+    """Batch index disambiguates same-version commits (ref: CommitID
+    batchIndex)."""
+
+    async def main():
+        from foundationdb_tpu.core import spawn
+        from foundationdb_tpu.core.actors import all_of
+
+        c = LocalCluster().start()
+        db = c.database()
+
+        async def one(i):
+            tr = db.create_transaction()
+            tr.set_versionstamped_key(_stamp_key(b"q/"), b"%d" % i)
+            f = tr.get_versionstamp()
+            await tr.commit()
+            return await f
+
+        tasks = [spawn(one(i)) for i in range(4)]
+        stamps = await all_of([t.done for t in tasks])
+        assert len(set(stamps)) == 4  # all distinct even if same version
+        rows = await db.transact(lambda tr: tr.get_range(b"q/", b"q0"))
+        assert len(rows) == 4
+        c.stop()
+
+    sim.run(main())
+
+
+def test_versionstamp_promise_fails_on_reset(sim):
+    async def main():
+        c = LocalCluster().start()
+        db = c.database()
+        tr = db.create_transaction()
+        tr.set_versionstamped_key(_stamp_key(b"x/"), b"v")
+        f = tr.get_versionstamp()
+        tr.reset()
+        from foundationdb_tpu.core.errors import TransactionCancelled
+
+        with pytest.raises(TransactionCancelled):
+            await f
+        c.stop()
+
+    sim.run(main())
+
+
+def test_malformed_stamp_param_fails_client_side(sim):
+    async def main():
+        c = LocalCluster().start()
+        db = c.database()
+        tr = db.create_transaction()
+        with pytest.raises(ValueError):
+            tr.set_versionstamped_key(b"ab", b"v")  # no offset suffix
+        with pytest.raises(ValueError):
+            tr.set_versionstamped_key(
+                b"ab" + struct.pack("<I", 1), b"v"  # stamp out of range
+            )
+        # The transaction (and the shared proxy) are unharmed.
+        tr.set(b"k", b"v")
+        await tr.commit()
+        assert await db.get(b"k") == b"v"
+        c.stop()
+
+    sim.run(main())
+
+
+def test_read_only_get_versionstamp_errors(sim):
+    async def main():
+        from foundationdb_tpu.core.errors import NoCommitVersion
+
+        c = LocalCluster().start()
+        db = c.database()
+        tr = db.create_transaction()
+        await tr.get(b"nothing")
+        f = tr.get_versionstamp()
+        await tr.commit()  # read-only fast path
+        with pytest.raises(NoCommitVersion):
+            await f
+        c.stop()
+
+    sim.run(main())
