@@ -1,0 +1,134 @@
+"""Directory layer + HighContentionAllocator tests (ref:
+bindings/python/fdb/directory_impl.py)."""
+
+import pytest
+
+from foundationdb_tpu.cluster.cluster import LocalCluster
+from foundationdb_tpu.layers.directory import DirectoryLayer
+
+
+def test_directory_create_open_list_remove(sim):
+    async def main():
+        c = LocalCluster().start()
+        db = c.database()
+        dl = DirectoryLayer()
+
+        async def body(tr):
+            app = await dl.create_or_open(tr, ("app",))
+            users = await dl.create_or_open(tr, ("app", "users"))
+            events = await dl.create_or_open(tr, ("app", "events"))
+            tr.set(users.pack((42,)), b"alice")
+            tr.set(events.pack((1,)), b"login")
+            return app, users, events
+
+        app, users, events = await db.transact(body)
+        # Prefixes are short and distinct.
+        assert users.key() != events.key() != app.key()
+        assert len(users.key()) <= 6
+
+        async def check(tr):
+            assert await dl.exists(tr, ("app", "users"))
+            assert not await dl.exists(tr, ("app", "nope"))
+            names = await dl.list(tr, ("app",))
+            assert sorted(names) == ["events", "users"]
+            u = await dl.open(tr, ("app", "users"))
+            assert u.key() == users.key()
+            assert await tr.get(u.pack((42,))) == b"alice"
+
+        await db.transact(check)
+
+        async def remove(tr):
+            await dl.remove(tr, ("app", "events"))
+
+        await db.transact(remove)
+
+        async def check2(tr):
+            assert not await dl.exists(tr, ("app", "events"))
+            assert await dl.list(tr, ("app",)) == ["users"]
+            # Content under the removed prefix is gone.
+            rows = await tr.get_range(events.key(), events.key() + b"\xff")
+            assert rows == []
+
+        await db.transact(check2)
+        c.stop()
+
+    sim.run(main())
+
+
+def test_directory_move_keeps_contents(sim):
+    async def main():
+        c = LocalCluster().start()
+        db = c.database()
+        dl = DirectoryLayer()
+
+        async def body(tr):
+            d = await dl.create_or_open(tr, ("a", "b"))
+            tr.set(d.pack(("x",)), b"1")
+            return d
+
+        d = await db.transact(body)
+
+        async def mv(tr):
+            await dl.create_or_open(tr, ("c",))
+            return await dl.move(tr, ("a", "b"), ("c", "b2"))
+
+        moved = await db.transact(mv)
+        assert moved.key() == d.key()  # same prefix, contents intact
+
+        async def check(tr):
+            assert not await dl.exists(tr, ("a", "b"))
+            m = await dl.open(tr, ("c", "b2"))
+            assert await tr.get(m.pack(("x",))) == b"1"
+
+        await db.transact(check)
+        c.stop()
+
+    sim.run(main())
+
+
+def test_directory_layer_tag_conflict(sim):
+    async def main():
+        c = LocalCluster().start()
+        db = c.database()
+        dl = DirectoryLayer()
+
+        async def body(tr):
+            await dl.create_or_open(tr, ("typed",), layer=b"queue")
+
+        await db.transact(body)
+
+        async def body2(tr):
+            await dl.create_or_open(tr, ("typed",), layer=b"blob")
+
+        with pytest.raises(ValueError):
+            await db.transact(body2)
+        c.stop()
+
+    sim.run(main())
+
+
+def test_hca_concurrent_allocations_unique(sim):
+    """Many concurrent allocators must never hand out the same prefix
+    (the HCA's whole purpose, ref: directory_impl.py allocate)."""
+
+    async def main():
+        from foundationdb_tpu.core import spawn
+        from foundationdb_tpu.core.actors import all_of
+
+        c = LocalCluster().start()
+        db = c.database()
+        dl = DirectoryLayer()
+
+        async def make(i):
+            async def body(tr):
+                d = await dl.create_or_open(tr, ("dirs", "d%02d" % i))
+                return d.key()
+
+            return await db.transact(body)
+
+        tasks = [spawn(make(i)) for i in range(24)]
+        keys = await all_of([t.done for t in tasks])
+        assert len(set(keys)) == 24, "allocator handed out duplicate prefixes"
+        c.stop()
+
+    sim.run(main())
