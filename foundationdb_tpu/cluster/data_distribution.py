@@ -80,11 +80,14 @@ async def move_keys(cluster, r: KeyRange, new_team: Sequence[int],
         ).detail("NewTeam", list(new_team)).log()
 
         # -- start: union the teams so dests receive the live stream, and
-        #    mark dests ASSIGNED so they stop discarding it. Union CLIPPED
-        #    to r: slices of overlapping shards outside r keep their old
-        #    team (finish only rewrites r, so start must too).
+        #    mark dests ASSIGNED so they stop discarding it — but
+        #    BUFFERING (begin_fetch) until the snapshot lands, so atomics
+        #    never apply against a half-fetched base. Union CLIPPED to r:
+        #    slices of overlapping shards outside r keep their old team
+        #    (finish only rewrites r, so start must too).
         for t in dests:
             cluster.storages[t].set_assigned(r.begin, r.end, True)
+            cluster.storages[t].begin_fetch(r)
         for b, e, team in old_slices:
             union = tuple(sorted(set(team) | set(new_team)))
             cluster.shard_map.set_team(KeyRange(b, e), union)
@@ -101,27 +104,35 @@ async def move_keys(cluster, r: KeyRange, new_team: Sequence[int],
             await cluster.storages[t].version.when_at_least(v_f)
         if dests:
             avoid = set(avoid_donors)
+            all_rows: list = []
             for b, e, team in old_slices:
                 donors = [t for t in team if t not in avoid]
                 if not donors:
                     from ..core.errors import OperationFailed
 
+                    # Abort the move: dests must not buffer forever, and
+                    # the map must roll back to the pre-move teams (a
+                    # lingering union team would name dests that hold
+                    # nothing and later moves could pick them as donors).
+                    for t in dests:
+                        s = cluster.storages[t]
+                        s.abort_fetch(r)
+                        s.set_assigned(r.begin, r.end, False)
+                    for ob, oe, oteam in old_slices:
+                        cluster.shard_map.set_team(KeyRange(ob, oe), oteam)
                     raise OperationFailed(
                         f"move_keys: no surviving donor for [{b!r}, {e!r})"
                     )
                 donor = cluster.storages[min(donors)]
                 await donor.version.when_at_least(v_f)
-                rows = donor.data.get_range(b, e, v_f)
-                for t in dests:
-                    s = cluster.storages[t]
-                    for k, v in rows:
-                        s.data.set_snapshot(k, v, v_f)
-                        s.metrics.on_set(k, v)
+                all_rows.extend(donor.data.get_range(b, e, v_f))
             for t in dests:
+                s = cluster.storages[t]
+                # Snapshot beneath, buffered stream replayed on top.
+                s.end_fetch(r, all_rows, v_f)
                 # Reads below the fence never reflect pre-fetch history
                 # on a destination (ref: the fetched shard's readable
                 # version gating in AddingShard).
-                s = cluster.storages[t]
                 s.oldest_version = max(s.oldest_version, v_f)
 
         # -- finish: flip readability + the map --
@@ -200,10 +211,17 @@ class DataDistributor:
         ]
         return max(sizes) if sizes else 0.0
 
+    def _unplaceable(self) -> set:
+        """Failed servers plus operator exclusions (ref: DD honoring
+        excludedServers, DataDistribution.actor.cpp server exclusion
+        checks): neither may hold shards, but an EXCLUDED server is alive
+        and still donates during the drain."""
+        return self.failed | getattr(self.cluster, "excluded", set())
+
     def _healthy_replicas(self) -> list[Replica]:
+        bad = self._unplaceable()
         return [
-            rep for rep in self.cluster.replicas
-            if int(rep.id) not in self.failed
+            rep for rep in self.cluster.replicas if int(rep.id) not in bad
         ]
 
     def _pick_team(self, avoid: Sequence[int] = ()) -> Optional[tuple]:
@@ -241,14 +259,15 @@ class DataDistributor:
     async def _heal_one(self) -> None:
         """Replace failed members in one unhealthy shard (ref:
         teamTracker's zeroHealthyTeams/servers-left logic)."""
+        unplaceable = self._unplaceable()
         for b, e, team in self.cluster.shard_map.ranges():
             if not team:
                 continue
             e = e if e is not None else KEYSPACE_END
-            bad = [t for t in team if t in self.failed]
+            bad = [t for t in team if t in unplaceable]
             if not bad:
                 continue
-            survivors = [t for t in team if t not in self.failed]
+            survivors = [t for t in team if t not in unplaceable]
             new_team = self._pick_team(avoid=bad)
             if new_team is None or not survivors:
                 TraceEvent("DDCannotHeal", severity=30).detail(
@@ -267,7 +286,7 @@ class DataDistributor:
                 "Bad", bad
             ).detail("NewTeam", list(target)).log()
             await move_keys(self.cluster, KeyRange(b, e), target, self.lock,
-                            avoid_donors=bad)
+                            avoid_donors=[t for t in bad if t in self.failed])
             self.moves_done += 1
             return
 

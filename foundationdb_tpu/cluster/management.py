@@ -1,0 +1,85 @@
+"""Management API: operator actions as ordinary transactions on the
+system keyspace (ref: fdbclient/ManagementAPI.actor.cpp — configure,
+exclude/include, coordinators; everything is \\xff key writes that the
+proxy's metadata-apply path interprets)."""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from .system_data import (
+    config_key,
+    decode_excluded_server_key,
+    excluded_server_key,
+    excluded_servers_range,
+)
+
+
+async def exclude_servers(db, tags: Iterable[int]) -> None:
+    """Mark storage servers excluded: DD drains their data and stops
+    placing new shards on them (ref: excludeServers,
+    ManagementAPI.actor.cpp:908 — writes excludedServersPrefix keys)."""
+    tags = list(tags)
+
+    async def body(tr):
+        tr.options.set_access_system_keys()
+        for t in tags:
+            tr.set(excluded_server_key(t), b"")
+
+    await db.transact(body)
+
+
+async def include_servers(db, tags: Iterable[int] = None) -> None:
+    """Clear exclusions (all of them when tags is None), re-admitting the
+    servers for placement (ref: includeServers :1006)."""
+    tags = None if tags is None else list(tags)
+
+    async def body(tr):
+        tr.options.set_access_system_keys()
+        if tags is None:
+            r = excluded_servers_range()
+            tr.clear_range(r.begin, r.end)
+        else:
+            for t in tags:
+                tr.clear(excluded_server_key(t))
+
+    await db.transact(body)
+
+
+async def get_excluded_servers(db) -> set[int]:
+    async def body(tr):
+        tr.options.set_read_system_keys()
+        r = excluded_servers_range()
+        rows = await tr.get_range(r.begin, r.end)
+        return {decode_excluded_server_key(k) for k, _ in rows}
+
+    return await db.transact(body)
+
+
+async def configure(db, **settings) -> None:
+    """Set replicated configuration values, e.g.
+    configure(db, redundancy_mode="triple", logs=4) (ref: changeConfig,
+    ManagementAPI.actor.cpp:62 — writes \\xff/conf/ keys)."""
+
+    async def body(tr):
+        tr.options.set_access_system_keys()
+        for name, value in settings.items():
+            tr.set(config_key(name), str(value).encode())
+
+    await db.transact(body)
+
+
+async def get_configuration(db) -> dict:
+    from .system_data import CONF_PREFIX, EXCLUDED_PREFIX, decode_config_key
+
+    async def body(tr):
+        tr.options.set_read_system_keys()
+        rows = await tr.get_range(CONF_PREFIX, CONF_PREFIX + b"\xff")
+        out = {}
+        for k, v in rows:
+            if k.startswith(EXCLUDED_PREFIX):
+                continue
+            out[decode_config_key(k)] = v.decode()
+        return out
+
+    return await db.transact(body)

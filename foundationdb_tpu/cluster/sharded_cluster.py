@@ -94,6 +94,11 @@ class ShardedKVCluster:
             ratekeeper=self.ratekeeper,
             log_system=self.log_system, shard_map=self.shard_map,
         )
+        # Replicated cluster configuration, maintained from committed \xff
+        # mutations (ref: DatabaseConfiguration fed by ApplyMetadataMutation).
+        self.config_values: dict[str, str] = {}
+        self.excluded: set[int] = set()
+        self.proxy.metadata_hook = self._apply_metadata
         self.dd = None
         self._started = False
 
@@ -105,6 +110,37 @@ class ShardedKVCluster:
         self.ratekeeper.start()
         self.proxy.start()
         return self
+
+    def _apply_metadata(self, m) -> None:
+        """(ref: applyMetadataMutations — interpret committed \\xff writes
+        into live config: exclusions + configuration values)."""
+        from ..kv.atomic import MutationType
+        from .system_data import (
+            CONF_PREFIX,
+            EXCLUDED_PREFIX,
+            decode_config_key,
+            decode_excluded_server_key,
+        )
+
+        from .system_data import excluded_server_key
+
+        if m.type == MutationType.SET_VALUE:
+            if m.param1.startswith(EXCLUDED_PREFIX):
+                self.excluded.add(decode_excluded_server_key(m.param1))
+            elif m.param1.startswith(CONF_PREFIX):
+                self.config_values[decode_config_key(m.param1)] = (
+                    m.param2.decode()
+                )
+        elif m.type == MutationType.CLEAR_RANGE:
+            for t in list(self.excluded):
+                if m.param1 <= excluded_server_key(t) < m.param2:
+                    self.excluded.discard(t)
+            for name in list(self.config_values):
+                k = CONF_PREFIX + name.encode()
+                if m.param1 <= k < m.param2 and not k.startswith(
+                    EXCLUDED_PREFIX
+                ):
+                    del self.config_values[name]
 
     def start_data_distribution(self, interval: float = 0.5):
         """Run the DD role against this cluster (ref: dataDistribution,

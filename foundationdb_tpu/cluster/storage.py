@@ -45,6 +45,12 @@ class StorageServer:
         # member must not resurrect moved data from late union-tagged
         # commits.
         self.assigned = KeyRangeMap(True)
+        # Active shard fetches: while a range is being fetched, its stream
+        # mutations are BUFFERED and replayed after the snapshot lands
+        # (ref: AddingShard's update buffering, storageserver.actor.cpp
+        # :77,:1761 — applying an atomic op against a half-fetched base
+        # would corrupt the replica).
+        self._fetches: list[tuple[KeyRange, list]] = []
         # Byte-sampled metrics for DD sizing/splitting (ref:
         # StorageMetrics.actor.h; fed from the apply path like
         # byteSampleApplySet, storageserver.actor.cpp:2870).
@@ -107,18 +113,78 @@ class StorageServer:
                 self.data.forget_before(new_oldest)
             self.tlog.pop(self.version.get())
 
+    # -- shard fetch buffering (ref: AddingShard, :77) --
+    def begin_fetch(self, r: KeyRange) -> None:
+        self._fetches.append((r, []))
+
+    def end_fetch(self, r: KeyRange, rows, fence_version: int) -> None:
+        """Apply the fetched snapshot, then replay everything the stream
+        delivered for the range since begin_fetch, in order."""
+        for i, (fr, buffered) in enumerate(self._fetches):
+            if fr == r:
+                del self._fetches[i]
+                break
+        else:
+            raise ValueError(f"no active fetch for {r!r}")
+        for k, v in rows:
+            self.data.set_snapshot(k, v, fence_version)
+            self.metrics.on_set(k, v)
+        for version, m in buffered:
+            if version > fence_version:
+                self._apply(m, version)
+
+    def abort_fetch(self, r: KeyRange) -> None:
+        """Abandon an in-progress fetch: drop its buffer (the range was
+        never readable here) (ref: AddingShard cancellation)."""
+        self._fetches = [
+            (fr, buf) for fr, buf in self._fetches if fr != r
+        ]
+
+    def _fetch_buffer_for(self, key: bytes):
+        for fr, buffered in self._fetches:
+            if fr.contains(key):
+                return buffered
+        return None
+
     def _apply(self, m: Mutation, version: int) -> None:
         if m.type == MutationType.CLEAR_RANGE:
-            # Apply only the assigned slices of the cleared range.
+            # Apply only the assigned slices of the cleared range. Parts
+            # under an active fetch buffer — CLIPPED to the fetch range:
+            # the assigned map coalesces, so one assigned slice can span
+            # both fetching and live data, and the live part must clear
+            # NOW (buffering it would serve stale rows until end_fetch).
             for b, e, ok in self.assigned.intersecting(
                 KeyRange(m.param1, m.param2)
             ):
-                if ok:
-                    e2 = e if e is not None else m.param2
-                    self.data.clear_range(b, e2, version)
-                    self.metrics.on_clear_range(b, e2)
+                if not ok:
+                    continue
+                e2 = e if e is not None else m.param2
+                segs = [(b, e2)]
+                for fr, buffered in self._fetches:
+                    nxt = []
+                    for sb, se in segs:
+                        ib, ie = max(sb, fr.begin), min(se, fr.end)
+                        if ib < ie:
+                            buffered.append((
+                                version,
+                                Mutation(MutationType.CLEAR_RANGE, ib, ie),
+                            ))
+                            if sb < ib:
+                                nxt.append((sb, ib))
+                            if ie < se:
+                                nxt.append((ie, se))
+                        else:
+                            nxt.append((sb, se))
+                    segs = nxt
+                for sb, se in segs:
+                    self.data.clear_range(sb, se, version)
+                    self.metrics.on_clear_range(sb, se)
             return
         if not self.assigned[m.param1]:
+            return
+        buf = self._fetch_buffer_for(m.param1)
+        if buf is not None:
+            buf.append((version, m))
             return
         if m.type == MutationType.SET_VALUE:
             self.data.set(m.param1, m.param2, version)

@@ -37,20 +37,22 @@ class VersionedMap:
             insort(self._keys, key)
         return c
 
-    # -- writes (must be applied in non-decreasing version order) --
+    # -- writes (must be applied in non-decreasing PER-KEY version order;
+    #    cross-key order may interleave, e.g. a fetched shard replaying
+    #    its buffered updates while other shards already advanced) --
     def set(self, key: bytes, value: bytes, version: int) -> None:
-        assert version >= self.latest_version
-        self.latest_version = version
         c = self._chain(key)
+        assert not c or version >= c[-1][0], "per-key version order"
+        self.latest_version = max(self.latest_version, version)
         if c and c[-1][0] == version:
             c[-1] = (version, value)
         else:
             c.append((version, value))
 
     def clear(self, key: bytes, version: int) -> None:
-        assert version >= self.latest_version
-        self.latest_version = version
         c = self._chain(key)
+        assert not c or version >= c[-1][0], "per-key version order"
+        self.latest_version = max(self.latest_version, version)
         if c and c[-1][0] == version:
             c[-1] = (version, None)
         else:
