@@ -114,6 +114,7 @@ async def restore(
     marker = RESTORE_MARKER
 
     async def begin_body(tr):
+        tr.options.set_access_system_keys()
         tr.set(marker, path.encode())
         tr.clear_range(begin, end)
 
@@ -140,6 +141,7 @@ async def restore(
             total += len(chunk)
 
     async def finish_body(tr):
+        tr.options.set_access_system_keys()
         tr.clear(marker)
 
     await db.transact(finish_body)

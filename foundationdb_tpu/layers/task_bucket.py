@@ -1,0 +1,167 @@
+"""TaskBucket: a persistent, leased task queue stored in the database
+itself (ref: fdbclient/TaskBucket.actor.cpp — the execution fabric for
+backup/restore/DR; tasks are KV entries under a subspace, claimed with
+time-limited leases and re-queued when an executor dies).
+
+Layout under the bucket subspace (mirroring the reference's shape):
+
+    available/<priority>/<task_id>        -> packed params
+    timeouts/<lease_version>/<task_id>    -> packed params  (claimed)
+
+Claiming moves a task from `available` to `timeouts` keyed by the lease
+expiry version; `finish` deletes it; an expired lease is swept back to
+`available`, so a crashed agent's work is retried — at-least-once
+execution, exactly the reference's contract.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..core.knobs import SERVER_KNOBS
+from ..core.runtime import current_loop
+from .subspace import Subspace
+from .tuple import pack, unpack
+
+
+class Task:
+    def __init__(self, task_id: bytes, priority: int, params: dict,
+                 lease_version: int = 0):
+        self.id = task_id
+        self.priority = priority
+        self.params = params
+        self.lease_version = lease_version
+
+    def __repr__(self):
+        return f"Task({self.id.hex()}, p{self.priority}, {self.params})"
+
+
+def _pack_params(params: dict) -> bytes:
+    items = []
+    for k in sorted(params):
+        items.extend([k, params[k]])
+    return pack(tuple(items))
+
+
+def _unpack_params(raw: bytes) -> dict:
+    items = unpack(raw)
+    return {items[i]: items[i + 1] for i in range(0, len(items), 2)}
+
+
+class TaskBucket:
+    def __init__(self, subspace: Subspace):
+        self.available = subspace[b"available"]
+        self.timeouts = subspace[b"timeouts"]
+
+    # -- producer side --
+    def add(self, tr, params: dict, priority: int = 0) -> bytes:
+        """Enqueue; returns the task id (ref: TaskBucket::addTask)."""
+        task_id = bytes(
+            current_loop().random.random_int(0, 256) for _ in range(16)
+        )
+        tr.set(
+            self.available.pack((priority, task_id)), _pack_params(params)
+        )
+        return task_id
+
+    # -- consumer side --
+    async def get_one(self, tr) -> Optional[Task]:
+        """Claim one task: highest priority first, random within a
+        priority band (ref: getOne's random scan to dodge contention).
+        The claim conflicts with other claimants of the SAME task only."""
+        b, e = self.available.range()
+        rows = await tr.get_range(b, e, snapshot=True)
+        if not rows:
+            return None
+        # Highest priority = highest tuple value first.
+        best_priority = max(
+            self.available.unpack(k)[0] for k, _ in rows
+        )
+        candidates = [
+            (k, v) for k, v in rows
+            if self.available.unpack(k)[0] == best_priority
+        ]
+        k, v = candidates[
+            current_loop().random.random_int(0, len(candidates))
+        ]
+        # Conflict with concurrent claimants of this task.
+        taken = await tr.get(k)
+        if taken is None:
+            return None  # raced: claimed+finished under us; caller retries
+        priority, task_id = self.available.unpack(k)
+        lease = (
+            await tr.get_read_version()
+            + SERVER_KNOBS.TASKBUCKET_TIMEOUT_VERSIONS
+        )
+        tr.clear(k)
+        tr.set(self.timeouts.pack((lease, task_id)), v)
+        return Task(task_id, priority, _unpack_params(v), lease)
+
+    def finish(self, tr, task: Task) -> None:
+        """(ref: TaskBucket::finish) — done; drop the lease entry."""
+        tr.clear(self.timeouts.pack((task.lease_version, task.id)))
+
+    async def extend(self, tr, task: Task) -> Task:
+        """Renew the lease of a long-running task (ref: extendTimeout)."""
+        old_key = self.timeouts.pack((task.lease_version, task.id))
+        raw = await tr.get(old_key)
+        if raw is None:
+            raise KeyError("lease lost (timed out and reclaimed)")
+        new_lease = (
+            await tr.get_read_version()
+            + SERVER_KNOBS.TASKBUCKET_TIMEOUT_VERSIONS
+        )
+        tr.clear(old_key)
+        tr.set(self.timeouts.pack((new_lease, task.id)), raw)
+        return Task(task.id, task.priority, task.params, new_lease)
+
+    async def sweep_timeouts(self, tr) -> int:
+        """Requeue every task whose lease expired (ref: checkTimeouts).
+        Returns how many were requeued."""
+        rv = await tr.get_read_version()
+        b = self.timeouts.range()[0]
+        e = self.timeouts.pack((rv,))
+        rows = await tr.get_range(b, e)
+        for k, v in rows:
+            _, task_id = self.timeouts.unpack(k)
+            tr.clear(k)
+            tr.set(self.available.pack((0, task_id)), v)
+        return len(rows)
+
+    async def is_empty(self, tr) -> bool:
+        for space in (self.available, self.timeouts):
+            b, e = space.range()
+            if await tr.get_range(b, e, limit=1):
+                return False
+        return True
+
+    # -- the agent loop (ref: TaskBucket::run / doOne) --
+    async def run_agent(self, db, executor, poll_interval: float = 0.2,
+                        stop_when_empty: bool = False):
+        """Claim-execute-finish forever (or until drained). `executor` is
+        `async (db, task) -> None`; raising leaves the task leased, to be
+        retried after the lease expires — at-least-once."""
+        loop = current_loop()
+        while True:
+            async def claim(tr):
+                await self.sweep_timeouts(tr)
+                return await self.get_one(tr)
+
+            task = await db.transact(claim)
+            if task is None:
+                if stop_when_empty:
+                    async def empty(tr):
+                        return await self.is_empty(tr)
+
+                    if await db.transact(empty):
+                        return
+                await loop.delay(
+                    poll_interval * (0.7 + 0.6 * loop.random.random01())
+                )
+                continue
+            await executor(db, task)
+
+            async def fin(tr):
+                self.finish(tr, task)
+
+            await db.transact(fin)
