@@ -1,0 +1,162 @@
+"""Transaction/database option tests (ref: fdbclient/vexillographer/
+fdb.options; option semantics in NativeAPI/ReadYourWrites)."""
+
+import pytest
+
+from foundationdb_tpu.cluster.cluster import LocalCluster
+from foundationdb_tpu.core import delay
+from foundationdb_tpu.core.errors import (
+    KeyOutsideLegalRange,
+    NotCommitted,
+    TransactionTimedOut,
+)
+
+
+def test_system_keys_gated(sim):
+    async def main():
+        c = LocalCluster().start()
+        db = c.database()
+        tr = db.create_transaction()
+        with pytest.raises(KeyOutsideLegalRange):
+            tr.set(b"\xff/foo", b"x")
+        with pytest.raises(KeyOutsideLegalRange):
+            await tr.get(b"\xff/foo")
+        tr.options.set_access_system_keys()
+        tr.set(b"\xff/foo", b"x")
+        await tr.commit()
+
+        tr2 = db.create_transaction()
+        tr2.options.set_read_system_keys()
+        assert await tr2.get(b"\xff/foo") == b"x"
+        with pytest.raises(KeyOutsideLegalRange):
+            tr2.set(b"\xff/foo", b"y")  # read-only grant
+        c.stop()
+
+    sim.run(main())
+
+
+def test_timeout_option(sim):
+    async def main():
+        c = LocalCluster().start()
+        db = c.database()
+        tr = db.create_transaction()
+        tr.options.set_timeout(500)  # ms
+        tr.set(b"k", b"v")
+        await tr.commit()  # fast path: fine
+
+        tr2 = db.create_transaction()
+        tr2.options.set_timeout(500)
+        await delay(1.0)
+        with pytest.raises(TransactionTimedOut):
+            await tr2.get(b"k")
+        c.stop()
+
+    sim.run(main())
+
+
+def test_retry_limit_option(sim):
+    async def main():
+        c = LocalCluster().start()
+        db = c.database()
+        await db.set(b"contended", b"0")
+
+        # Force a conflict: read, then another txn writes, then commit.
+        tr = db.create_transaction()
+        tr.options.set_retry_limit(0)
+        await tr.get(b"contended")
+        await db.set(b"contended", b"1")
+        tr.set(b"other", b"x")
+        with pytest.raises(NotCommitted):
+            try:
+                await tr.commit()
+            except NotCommitted as e:
+                # retry_limit 0: on_error must re-raise, not reset.
+                await tr.on_error(e)
+        c.stop()
+
+    sim.run(main())
+
+
+def test_ryw_disable(sim):
+    async def main():
+        c = LocalCluster().start()
+        db = c.database()
+        await db.set(b"k", b"committed")
+        tr = db.create_transaction()
+        tr.options.set_read_your_writes_disable()
+        tr.set(b"k", b"pending")
+        # Reads ignore the uncommitted write.
+        assert await tr.get(b"k") == b"committed"
+        await tr.commit()
+        assert await db.get(b"k") == b"pending"
+        c.stop()
+
+    sim.run(main())
+
+
+def test_max_retry_delay_caps_backoff(sim):
+    async def main():
+        c = LocalCluster().start()
+        db = c.database()
+        tr = db.create_transaction()
+        tr.options.set_max_retry_delay(20)  # ms
+        for _ in range(12):
+            tr._reset_for_retry(tr._backoff)
+        assert tr._backoff <= 0.020 + 1e-9
+        c.stop()
+
+    sim.run(main())
+
+
+def test_system_range_end_gated(sim):
+    """clear_range/get_range spanning into \xff must be gated even when
+    begin is a normal key."""
+
+    async def main():
+        c = LocalCluster().start()
+        db = c.database()
+        tr = db.create_transaction()
+        with pytest.raises(KeyOutsideLegalRange):
+            tr.clear_range(b"z", b"\xff\xf0")
+        with pytest.raises(KeyOutsideLegalRange):
+            await tr.get_range(b"z", b"\xff\xf0")
+        tr.options.set_access_system_keys()
+        tr.clear_range(b"z", b"\xff\xf0")  # now allowed
+        c.stop()
+
+    sim.run(main())
+
+
+def test_setting_unrelated_option_does_not_refill_budget(sim):
+    async def main():
+        c = LocalCluster().start()
+        db = c.database()
+        tr = db.create_transaction()
+        tr.options.set_retry_limit(2)
+        tr._retries_left = 0  # budget spent
+        tr.options.set_access_system_keys()  # unrelated option
+        assert tr._retries_left == 0, "unrelated option refilled retries"
+        tr.options.set_timeout(1000)
+        d1 = tr._deadline
+        await delay(0.5)
+        tr.options.set_read_system_keys()
+        assert tr._deadline == d1, "unrelated option moved the deadline"
+        c.stop()
+
+    sim.run(main())
+
+
+def test_ryw_disable_applies_to_ranges_too(sim):
+    async def main():
+        c = LocalCluster().start()
+        db = c.database()
+        await db.set(b"r/a", b"committed")
+        tr = db.create_transaction()
+        tr.options.set_read_your_writes_disable()
+        tr.set(b"r/a", b"pending")
+        tr.set(b"r/b", b"new")
+        rows = await tr.get_range(b"r/", b"r0")
+        assert rows == [(b"r/a", b"committed")]
+        c.stop()
+
+    sim.run(main())
