@@ -1,0 +1,72 @@
+"""SystemMonitor: periodic process/machine metrics as TraceEvents (ref:
+flow/SystemMonitor.cpp systemMonitor + flow/Platform.cpp probes — the
+reference emits ProcessMetrics/MachineMetrics events every interval;
+dashboards and Status scrape them from the trace stream)."""
+
+from __future__ import annotations
+
+import os
+import resource
+import time
+from typing import Optional
+
+from .runtime import Task, current_loop, spawn
+from .trace import TraceEvent
+
+
+def _read_proc_self() -> dict:
+    out: dict = {}
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        out["ResidentBytes"] = pages * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        out["OpenFDs"] = len(os.listdir("/proc/self/fd"))
+    except OSError:
+        pass
+    ru = resource.getrusage(resource.RUSAGE_SELF)
+    out["UserCPUSeconds"] = round(ru.ru_utime, 3)
+    out["SystemCPUSeconds"] = round(ru.ru_stime, 3)
+    return out
+
+
+class SystemMonitor:
+    """Emits ProcessMetrics on an interval; also tracks the event loop's
+    own health (tasks run, slow-task detection — ref: the run-loop rdtsc
+    slow task sampler, flow/Net2.actor.cpp:570)."""
+
+    def __init__(self, interval: float = 5.0):
+        self.interval = interval
+        self._task: Optional[Task] = None
+        self._last_tasks_run = 0
+        self._last_wall = time.monotonic()
+
+    def start(self) -> "SystemMonitor":
+        self._task = spawn(self._run(), name="systemMonitor")
+        return self
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+
+    def emit_once(self) -> None:
+        loop = current_loop()
+        wall = time.monotonic()
+        ev = TraceEvent("ProcessMetrics")
+        for k, v in _read_proc_self().items():
+            ev.detail(k, v)
+        ev.detail("LoopTasksRun", loop.tasks_run)
+        ev.detail("LoopTasksDelta", loop.tasks_run - self._last_tasks_run)
+        ev.detail("WallSeconds", round(wall - self._last_wall, 3))
+        ev.detail("SimTime", round(loop.now(), 6))
+        ev.log()
+        self._last_tasks_run = loop.tasks_run
+        self._last_wall = wall
+
+    async def _run(self):
+        loop = current_loop()
+        while True:
+            await loop.delay(self.interval)
+            self.emit_once()

@@ -1,0 +1,49 @@
+"""Profiler + SystemMonitor tests (ref: flow/Profiler.actor.cpp,
+flow/SystemMonitor.cpp)."""
+
+from foundationdb_tpu.core.profiler import Profiler
+from foundationdb_tpu.core.system_monitor import SystemMonitor
+from foundationdb_tpu.core import delay
+
+
+def _burn(n: int) -> int:
+    total = 0
+    for i in range(n):
+        total += i * i % 7
+    return total
+
+
+def test_profiler_samples_hot_function():
+    p = Profiler()
+    p.start(interval=0.001)
+    try:
+        _burn(3_000_000)
+    finally:
+        p.stop()
+    assert p.total_samples > 0
+    top = p.top_frames(5)
+    assert top, "no hotspots recorded"
+    assert any("_burn" in frame for frame, _ in top), top
+    p.dump()  # must not raise
+
+
+def test_profiler_stop_is_idempotent():
+    p = Profiler()
+    p.start(interval=0.01)
+    p.stop()
+    p.stop()
+
+
+def test_system_monitor_emits_metrics(sim):
+    from foundationdb_tpu.core.trace import global_sink
+
+    async def main():
+        mon = SystemMonitor(interval=1.0).start()
+        await delay(3.5)
+        mon.stop()
+
+    sim.run(main())
+    events = global_sink().find("ProcessMetrics")
+    assert len(events) >= 3
+    ev = events[-1]
+    assert "UserCPUSeconds" in ev and "LoopTasksRun" in ev
