@@ -1,0 +1,42 @@
+"""Shared loader for the native library (libfdbtpu_native.so): builds on
+demand (one make invocation) and hands each engine module one CDLL to
+declare its own prototypes on. Single point of truth for the build path
+so the diskqueue and ssd engine cannot drift."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+LIB_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native", "libfdbtpu_native.so",
+)
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    if not os.path.exists(LIB_PATH):
+        import subprocess
+
+        try:
+            subprocess.run(
+                ["make", "-C", os.path.dirname(LIB_PATH)],
+                capture_output=True, timeout=120, check=True,
+            )
+        except Exception:
+            return None
+    if not os.path.exists(LIB_PATH):
+        return None
+    try:
+        _lib = ctypes.CDLL(LIB_PATH)
+    except OSError:
+        _lib = None
+    return _lib

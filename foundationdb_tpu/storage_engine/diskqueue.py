@@ -25,30 +25,11 @@ HEADER = struct.Struct("<IQII")  # magic, seq, len, crc
 PAYLOAD_MAX = PAGE_SIZE - HEADER.size
 SEGMENT_BUDGET = 1 << 20
 
-_LIB_PATH = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
-    "native", "libfdbtpu_native.so",
-)
-
-
 def _load_native():
-    if not os.path.exists(_LIB_PATH):
-        # Build on demand (one g++ invocation); fall back to the Python
-        # backend on any failure (no compiler, read-only checkout, ...).
-        import subprocess
+    from ._native import load as _load_shared
 
-        try:
-            subprocess.run(
-                ["make", "-C", os.path.dirname(_LIB_PATH)],
-                capture_output=True, timeout=120, check=True,
-            )
-        except Exception:
-            return None
-    if not os.path.exists(_LIB_PATH):
-        return None
-    try:
-        lib = ctypes.CDLL(_LIB_PATH)
-    except OSError:
+    lib = _load_shared()
+    if lib is None:
         return None
     lib.dq_open.restype = ctypes.c_void_p
     lib.dq_open.argtypes = [ctypes.c_char_p]
@@ -100,9 +81,16 @@ class _PythonQueue:
     """Pure-Python twin of native/diskqueue.cpp (same format, same
     two-file reclamation contract)."""
 
-    def __init__(self, path_prefix: str):
+    def __init__(self, path_prefix: str, os_layer=None):
+        # The os-shaped seam: the real os module in production, the sim's
+        # NonDurableOS under fault-injection tests (ref: IAsyncFile's
+        # real/sim split, fdbrpc/AsyncFileNonDurable.actor.cpp).
+        self._os = os_layer if os_layer is not None else os
         self.paths = [path_prefix + ".q0", path_prefix + ".q1"]
-        self.fds = [os.open(p, os.O_RDWR | os.O_CREAT, 0o644) for p in self.paths]
+        self.fds = [
+            self._os.open(p, os.O_RDWR | os.O_CREAT, 0o644)
+            for p in self.paths
+        ]
         self.active = 0
         self.file_pages = [0, 0]
         self.min_seq = [None, None]
@@ -114,11 +102,11 @@ class _PythonQueue:
         self._recover()
 
     def _scan(self, which: int, out: list):
-        size = os.fstat(self.fds[which]).st_size
+        size = self._os.fstat(self.fds[which]).st_size
         pages = size // PAGE_SIZE
         self.file_pages[which] = pages
         for i in range(pages):
-            page = os.pread(self.fds[which], PAGE_SIZE, i * PAGE_SIZE)
+            page = self._os.pread(self.fds[which], PAGE_SIZE, i * PAGE_SIZE)
             if len(page) != PAGE_SIZE:
                 break
             magic, seq, ln, crc = HEADER.unpack_from(page)
@@ -159,7 +147,7 @@ class _PythonQueue:
             and self.max_seq[other] < self.popped_seq
         )
         if active_full and other_free:
-            os.ftruncate(self.fds[other], 0)
+            self._os.ftruncate(self.fds[other], 0)
             self.file_pages[other] = 0
             self.min_seq[other] = None
             self.max_seq[other] = None
@@ -179,7 +167,7 @@ class _PythonQueue:
             body += b"\x00" * (PAGE_SIZE - len(body))
             crc = _crc32c(body)
             page = HEADER.pack(MAGIC, seq, len(data), crc) + body[HEADER.size:]
-            os.pwrite(
+            self._os.pwrite(
                 self.fds[self.active], page,
                 self.file_pages[self.active] * PAGE_SIZE,
             )
@@ -190,7 +178,7 @@ class _PythonQueue:
             self.max_seq[which] = seq
         self.pending.clear()
         for fd in self.fds:
-            os.fsync(fd)
+            self._os.fsync(fd)
 
     def pop(self, upto_seq: int):
         self.popped_seq = max(self.popped_seq, upto_seq)
@@ -198,7 +186,7 @@ class _PythonQueue:
 
     def close(self):
         for fd in self.fds:
-            os.close(fd)
+            self._os.close(fd)
 
 
 class _NativeQueue:
@@ -254,7 +242,10 @@ class DiskQueue:
 
     PAYLOAD_MAX = PAYLOAD_MAX
 
-    def __init__(self, path_prefix: str, backend: Optional[str] = None):
+    def __init__(self, path_prefix: str, backend: Optional[str] = None,
+                 os_layer=None):
+        if os_layer is not None:
+            backend = "python"  # simulated disks run the Python twin
         if backend is None:
             backend = "native" if _NATIVE is not None else "python"
         if backend == "native":
@@ -264,7 +255,7 @@ class DiskQueue:
                 )
             self._impl = _NativeQueue(path_prefix)
         else:
-            self._impl = _PythonQueue(path_prefix)
+            self._impl = _PythonQueue(path_prefix, os_layer=os_layer)
         self.backend = backend
         self.recovered: list[tuple[int, bytes]] = list(self._impl.recovered)
 

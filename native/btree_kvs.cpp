@@ -1,0 +1,634 @@
+// "ssd" storage engine: a copy-on-write B+tree in one file with
+// checksummed pages, dual headers, and a persisted free list.
+//
+// Role model: the reference's ssd engine — a B-tree with page checksums
+// (fdbserver/KeyValueStoreSQLite.actor.cpp:67 PageChecksumCodec), large
+// value fragmentation (:409) and lazy space reclamation (springCleaning
+// :56-64). This is a fresh design for the same contract, NOT SQLite:
+//
+//   - Every node is a BLOB: a chain of 4 KiB pages, each carrying
+//     (magic, generation, next-page, payload length, CRC32C). Oversized
+//     keys/values simply make longer chains — fragmentation for free.
+//   - Writes are copy-on-write from leaf to root. commit() writes all
+//     dirty nodes to fresh pages, fsyncs, then flips one of two header
+//     pages (whichever is older) to the new root + generation, and
+//     fsyncs again. A crash at any point leaves a valid older tree.
+//   - Pages freed by COW join a free list persisted as its own blob;
+//     they are reusable from the NEXT commit on (the old tree must stay
+//     intact until the header flip) — lazy vacuum, like springCleaning.
+//
+// Exposed as a C ABI for the ctypes binding
+// (foundationdb_tpu/storage_engine/ssd_engine.py). Reads see uncommitted
+// writes immediately (IKeyValueStore semantics: the role applies
+// mutations, durability arrives at commit()).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <set>
+#include <string>
+#include <tuple>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint32_t kPageSize = 4096;
+constexpr uint32_t kMagic = 0x42545231;  // "BTR1"
+constexpr uint32_t kHdrMagic = 0x42544844;  // "BTHD"
+// page header: magic u32, crc u32, gen u64, next i64, len u32
+constexpr uint32_t kPageHdr = 4 + 4 + 8 + 8 + 4;
+constexpr uint32_t kPayloadMax = kPageSize - kPageHdr;
+constexpr size_t kNodeSplitBytes = 3200;  // serialized-size split trigger
+
+uint32_t crc_table[256];
+struct CrcInit {
+  CrcInit() {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++) c = (c & 1) ? 0x82F63B78u ^ (c >> 1) : c >> 1;
+      crc_table[i] = c;
+    }
+  }
+} crc_init;
+
+uint32_t crc32c(const uint8_t* d, size_t n, uint32_t crc = 0) {
+  crc ^= 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; i++) crc = crc_table[(crc ^ d[i]) & 0xFF] ^ (crc >> 8);
+  return crc ^ 0xFFFFFFFFu;
+}
+
+void put32(std::string& s, uint32_t v) { s.append((const char*)&v, 4); }
+void put64(std::string& s, uint64_t v) { s.append((const char*)&v, 8); }
+uint32_t get32(const uint8_t* p) { uint32_t v; memcpy(&v, p, 4); return v; }
+uint64_t get64(const uint8_t* p) { uint64_t v; memcpy(&v, p, 8); return v; }
+
+struct Node {
+  bool leaf = true;
+  std::vector<std::string> keys;
+  std::vector<std::string> values;   // leaf only
+  std::vector<int64_t> children;     // internal only; ids (page or temp)
+
+  size_t byte_size() const {
+    size_t n = 16;
+    for (auto& k : keys) n += k.size() + 8;
+    for (auto& v : values) n += v.size() + 8;
+    n += children.size() * 8;
+    return n;
+  }
+
+  std::string serialize() const {
+    std::string s;
+    s.push_back(leaf ? 1 : 0);
+    put32(s, (uint32_t)keys.size());
+    for (auto& k : keys) { put32(s, (uint32_t)k.size()); s += k; }
+    if (leaf) {
+      for (auto& v : values) { put32(s, (uint32_t)v.size()); s += v; }
+    } else {
+      for (auto c : children) put64(s, (uint64_t)c);
+    }
+    return s;
+  }
+
+  static std::unique_ptr<Node> deserialize(const std::string& s) {
+    auto n = std::make_unique<Node>();
+    const uint8_t* p = (const uint8_t*)s.data();
+    const uint8_t* end = p + s.size();
+    if (p >= end) return nullptr;
+    n->leaf = *p++ != 0;
+    if (p + 4 > end) return nullptr;
+    uint32_t nk = get32(p); p += 4;
+    n->keys.reserve(nk);
+    for (uint32_t i = 0; i < nk; i++) {
+      if (p + 4 > end) return nullptr;
+      uint32_t len = get32(p); p += 4;
+      if (p + len > end) return nullptr;
+      n->keys.emplace_back((const char*)p, len); p += len;
+    }
+    if (n->leaf) {
+      n->values.reserve(nk);
+      for (uint32_t i = 0; i < nk; i++) {
+        if (p + 4 > end) return nullptr;
+        uint32_t len = get32(p); p += 4;
+        if (p + len > end) return nullptr;
+        n->values.emplace_back((const char*)p, len); p += len;
+      }
+    } else {
+      n->children.reserve(nk + 1);
+      for (uint32_t i = 0; i + 1 <= nk + 1; i++) {
+        if (p + 8 > end) return nullptr;
+        n->children.push_back((int64_t)get64(p)); p += 8;
+      }
+    }
+    return n;
+  }
+};
+
+class BTreeKVS {
+ public:
+  explicit BTreeKVS(const std::string& path) : path_(path) {}
+
+  bool open() {
+    fd_ = ::open(path_.c_str(), O_RDWR | O_CREAT, 0644);
+    if (fd_ < 0) return false;
+    struct stat st;
+    fstat(fd_, &st);
+    if (st.st_size < (off_t)(2 * kPageSize)) {
+      // Fresh file: empty root leaf at generation 1.
+      page_count_ = 2;
+      generation_ = 0;
+      auto root = std::make_unique<Node>();
+      root_id_ = next_temp_--;
+      dirty_[root_id_] = std::move(root);
+      return commit();
+    }
+    page_count_ = st.st_size / kPageSize;
+    // Pick the newer valid header.
+    uint64_t best_gen = 0; bool found = false;
+    for (int h = 0; h < 2; h++) {
+      std::string pg = read_page_raw(h);
+      if (pg.size() != kPageSize) continue;
+      const uint8_t* p = (const uint8_t*)pg.data();
+      if (get32(p) != kHdrMagic) continue;
+      uint32_t crc = get32(p + 4);
+      std::string body = pg.substr(8, 48);
+      if (crc32c((const uint8_t*)body.data(), body.size()) != crc) continue;
+      uint64_t gen = get64(p + 8);
+      if (!found || gen > best_gen) {
+        best_gen = gen;
+        root_id_ = (int64_t)get64(p + 16);
+        free_blob_ = (int64_t)get64(p + 24);
+        page_count_ = get64(p + 32);
+        found = true;
+      }
+    }
+    if (!found) return false;
+    generation_ = best_gen;
+    // Load the free list.
+    free_.clear();
+    if (free_blob_ >= 0) {
+      std::string fl;
+      if (!read_blob(free_blob_, fl)) return false;
+      for (size_t i = 0; i + 8 <= fl.size(); i += 8)
+        free_.push_back((int64_t)get64((const uint8_t*)fl.data() + i));
+    }
+    return true;
+  }
+
+  void close() { if (fd_ >= 0) { ::close(fd_); fd_ = -1; } }
+
+  // -- mutations (visible immediately; durable at commit) --
+  void set(const std::string& k, const std::string& v) {
+    int64_t new_root = insert(root_id_, k, v);
+    root_id_ = new_root;
+    maybe_grow_root();
+  }
+
+  void clear_range(const std::string& b, const std::string& e) {
+    root_id_ = erase_range(root_id_, b, e);
+  }
+
+  bool get(const std::string& k, std::string& out) {
+    int64_t id = root_id_;
+    for (;;) {
+      Node* n = load(id);
+      if (!n) return false;
+      if (n->leaf) {
+        auto it = std::lower_bound(n->keys.begin(), n->keys.end(), k);
+        if (it == n->keys.end() || *it != k) return false;
+        out = n->values[it - n->keys.begin()];
+        return true;
+      }
+      size_t i = std::upper_bound(n->keys.begin(), n->keys.end(), k) - n->keys.begin();
+      id = n->children[i];
+    }
+  }
+
+  void read_range(const std::string& b, const std::string& e, uint64_t limit,
+                  std::vector<std::pair<std::string, std::string>>& out) {
+    scan(root_id_, b, e, limit, out);
+  }
+
+  bool commit() {
+    // Persist the free list FIRST (it references only this commit's view)
+    // then dirty nodes bottom-up so children have real ids.
+    std::vector<int64_t> freed_now;
+    std::swap(freed_now, pending_free_);
+    // Allocation pool for this commit: the PREVIOUS free list only.
+    alloc_pool_ = free_;
+    allocated_set_.clear();
+    // Write dirty nodes; remap temp ids.
+    std::map<int64_t, int64_t> remap;
+    // Children-first: repeatedly write nodes whose children are resolved.
+    bool progress = true;
+    while (!dirty_.empty() && progress) {
+      progress = false;
+      for (auto it = dirty_.begin(); it != dirty_.end();) {
+        Node* n = it->second.get();
+        bool ready = true;
+        if (!n->leaf) {
+          for (auto& c : n->children) {
+            if (c < 0) {
+              auto r = remap.find(c);
+              if (r == remap.end()) { ready = false; break; }
+              c = r->second;
+            }
+          }
+        }
+        if (!ready) { ++it; continue; }
+        int64_t real = write_blob(n->serialize());
+        remap[it->first] = real;
+        cache_[real] = std::move(it->second);
+        it = dirty_.erase(it);
+        progress = true;
+      }
+    }
+    if (!dirty_.empty()) return false;  // cycle: impossible by construction
+    if (root_id_ < 0) root_id_ = remap[root_id_];
+    // New free list = (old free - allocated now) + freed by this commit's
+    // COW; the old free-list blob itself is also freed.
+    std::vector<int64_t> new_free;
+    for (auto p : free_)
+      if (!allocated_set_.count(p)) new_free.push_back(p);
+    for (auto p : freed_now) new_free.push_back(p);
+    if (free_blob_ >= 0) free_pages_of(free_blob_, new_free);
+    std::string fl;
+    for (auto p : new_free) put64(fl, (uint64_t)p);
+    // The free-list blob's OWN pages must never appear in the list they
+    // hold (they are live metadata): allocate them by file extension
+    // only, after new_free is final. Old free-list pages recycle next
+    // commit, so the file does not grow unboundedly.
+    free_blob_ = fl.empty() ? -1 : write_blob(fl, /*from_pool=*/false);
+    // fsync data, flip the older header, fsync again.
+    if (fdatasync(fd_) != 0) return false;
+    generation_++;
+    std::string body;
+    put64(body, generation_);
+    put64(body, (uint64_t)root_id_);
+    put64(body, (uint64_t)free_blob_);
+    put64(body, page_count_);
+    body.resize(48, '\0');
+    std::string pg;
+    put32(pg, kHdrMagic);
+    put32(pg, crc32c((const uint8_t*)body.data(), body.size()));
+    pg += body;
+    pg.resize(kPageSize, '\0');
+    int hdr = generation_ % 2;
+    if (pwrite(fd_, pg.data(), kPageSize, (off_t)hdr * kPageSize) !=
+        (ssize_t)kPageSize)
+      return false;
+    if (fdatasync(fd_) != 0) return false;
+    free_ = std::move(new_free);
+    allocated_set_.clear();
+    return true;
+  }
+
+  uint64_t page_count() const { return page_count_; }
+  uint64_t free_pages() const { return free_.size(); }
+  // Checksum/structure failure observed on any read path: the caller
+  // must surface io_error, never "key not found" (detected corruption
+  // becoming silent data loss defeats checksumming).
+  bool corrupt() const { return corrupt_; }
+
+ private:
+  // -- page/blob IO --
+  std::string read_page_raw(uint64_t idx) {
+    std::string buf(kPageSize, '\0');
+    ssize_t n = pread(fd_, buf.data(), kPageSize, (off_t)idx * kPageSize);
+    if (n != (ssize_t)kPageSize) return std::string();
+    return buf;
+  }
+
+  bool read_blob(int64_t first, std::string& out) {
+    out.clear();
+    int64_t page = first;
+    while (page >= 0) {
+      std::string pg = read_page_raw(page);
+      if (pg.size() != kPageSize) return false;
+      const uint8_t* p = (const uint8_t*)pg.data();
+      if (get32(p) != kMagic) return false;
+      uint32_t crc = get32(p + 4);
+      int64_t next = (int64_t)get64(p + 16);
+      uint32_t len = get32(p + 24);
+      if (len > kPayloadMax) return false;
+      if (crc32c(p + 8, kPageHdr - 8 + len) != crc) return false;
+      out.append((const char*)(p + kPageHdr), len);
+      page = next;
+    }
+    return true;
+  }
+
+  int64_t alloc_page(bool from_pool) {
+    if (from_pool && !alloc_pool_.empty()) {
+      int64_t p = alloc_pool_.back();
+      alloc_pool_.pop_back();
+      allocated_set_.insert(p);
+      return p;
+    }
+    return (int64_t)page_count_++;
+  }
+
+  int64_t write_blob(const std::string& data, bool from_pool = true) {
+    size_t n_pages = std::max<size_t>(1, (data.size() + kPayloadMax - 1) / kPayloadMax);
+    std::vector<int64_t> pages;
+    for (size_t i = 0; i < n_pages; i++) pages.push_back(alloc_page(from_pool));
+    for (size_t i = 0; i < n_pages; i++) {
+      size_t off = i * kPayloadMax;
+      uint32_t len = (uint32_t)std::min((size_t)kPayloadMax, data.size() - off);
+      int64_t next = (i + 1 < n_pages) ? pages[i + 1] : -1;
+      std::string pg;
+      put32(pg, kMagic);
+      put32(pg, 0);  // crc placeholder
+      put64(pg, generation_ + 1);
+      put64(pg, (uint64_t)next);
+      put32(pg, len);
+      pg.append(data, off, len);
+      uint32_t crc = crc32c((const uint8_t*)pg.data() + 8, kPageHdr - 8 + len);
+      memcpy(pg.data() + 4, &crc, 4);
+      pg.resize(kPageSize, '\0');
+      pwrite(fd_, pg.data(), kPageSize, (off_t)pages[i] * kPageSize);
+    }
+    blob_pages_[pages[0]] = pages;
+    return pages[0];
+  }
+
+  void free_pages_of(int64_t blob_id, std::vector<int64_t>& into) {
+    auto it = blob_pages_.find(blob_id);
+    if (it != blob_pages_.end()) {
+      for (auto p : it->second) into.push_back(p);
+      blob_pages_.erase(it);
+      return;
+    }
+    // Walk the chain on disk.
+    int64_t page = blob_id;
+    while (page >= 0) {
+      into.push_back(page);
+      std::string pg = read_page_raw(page);
+      if (pg.size() != kPageSize) break;
+      const uint8_t* p = (const uint8_t*)pg.data();
+      if (get32(p) != kMagic) break;
+      page = (int64_t)get64(p + 16);
+    }
+  }
+
+  // -- node cache / COW --
+  Node* load(int64_t id) {
+    if (id < 0) {
+      auto it = dirty_.find(id);
+      if (it == dirty_.end()) { corrupt_ = true; return nullptr; }
+      return it->second.get();
+    }
+    auto it = cache_.find(id);
+    if (it != cache_.end()) return it->second.get();
+    std::string data;
+    if (!read_blob(id, data)) { corrupt_ = true; return nullptr; }
+    auto n = Node::deserialize(data);
+    if (!n) { corrupt_ = true; return nullptr; }
+    Node* raw = n.get();
+    cache_[id] = std::move(n);
+    return raw;
+  }
+
+  int64_t make_dirty(int64_t id) {
+    if (id < 0) return id;  // already dirty
+    Node* n = load(id);
+    auto copy = std::make_unique<Node>(*n);
+    int64_t tid = next_temp_--;
+    dirty_[tid] = std::move(copy);
+    // Old blob's pages recycle after the next header flip.
+    std::vector<int64_t> pages;
+    free_pages_of(id, pages);
+    for (auto p : pages) pending_free_.push_back(p);
+    cache_.erase(id);
+    return tid;
+  }
+
+  void maybe_grow_root() {
+    Node* r = load(root_id_);
+    if (r->byte_size() <= kNodeSplitBytes || r->keys.size() < 2) return;
+    auto [lid, rid, sep] = split(root_id_);
+    auto nr = std::make_unique<Node>();
+    nr->leaf = false;
+    nr->keys.push_back(sep);
+    nr->children = {lid, rid};
+    int64_t tid = next_temp_--;
+    dirty_[tid] = std::move(nr);
+    root_id_ = tid;
+  }
+
+  std::tuple<int64_t, int64_t, std::string> split(int64_t id) {
+    int64_t did = make_dirty(id);
+    Node* n = load(did);
+    size_t mid = n->keys.size() / 2;
+    auto right = std::make_unique<Node>();
+    right->leaf = n->leaf;
+    std::string sep;
+    if (n->leaf) {
+      sep = n->keys[mid];
+      right->keys.assign(n->keys.begin() + mid, n->keys.end());
+      right->values.assign(n->values.begin() + mid, n->values.end());
+      n->keys.resize(mid);
+      n->values.resize(mid);
+    } else {
+      sep = n->keys[mid];
+      right->keys.assign(n->keys.begin() + mid + 1, n->keys.end());
+      right->children.assign(n->children.begin() + mid + 1, n->children.end());
+      n->keys.resize(mid);
+      n->children.resize(mid + 1);
+    }
+    int64_t rid = next_temp_--;
+    dirty_[rid] = std::move(right);
+    return {did, rid, sep};
+  }
+
+  int64_t insert(int64_t id, const std::string& k, const std::string& v) {
+    int64_t did = make_dirty(id);
+    Node* n = load(did);
+    if (n->leaf) {
+      auto it = std::lower_bound(n->keys.begin(), n->keys.end(), k);
+      size_t i = it - n->keys.begin();
+      if (it != n->keys.end() && *it == k) {
+        n->values[i] = v;
+      } else {
+        n->keys.insert(it, k);
+        n->values.insert(n->values.begin() + i, v);
+      }
+      return did;
+    }
+    size_t i = std::upper_bound(n->keys.begin(), n->keys.end(), k) - n->keys.begin();
+    int64_t child = insert(n->children[i], k, v);
+    n->children[i] = child;
+    Node* c = load(child);
+    if (c->byte_size() > kNodeSplitBytes && c->keys.size() >= 2) {
+      auto [lid, rid, sep] = split(child);
+      n->children[i] = lid;
+      n->keys.insert(n->keys.begin() + i, sep);
+      n->children.insert(n->children.begin() + i + 1, rid);
+    }
+    return did;
+  }
+
+  int64_t erase_range(int64_t id, const std::string& b, const std::string& e) {
+    int64_t did = make_dirty(id);
+    Node* n = load(did);
+    if (n->leaf) {
+      auto lo = std::lower_bound(n->keys.begin(), n->keys.end(), b);
+      auto hi = std::lower_bound(n->keys.begin(), n->keys.end(), e);
+      size_t li = lo - n->keys.begin(), hi_i = hi - n->keys.begin();
+      n->keys.erase(lo, hi);
+      n->values.erase(n->values.begin() + li, n->values.begin() + hi_i);
+      return did;
+    }
+    // Children overlapping [b, e): child i covers (keys[i-1], keys[i]].
+    for (size_t i = 0; i < n->children.size(); i++) {
+      bool lo_ok = (i == 0) || (n->keys[i - 1] < e);
+      bool hi_ok = (i == n->keys.size()) || !(n->keys[i] < b);
+      if (lo_ok && hi_ok)
+        n->children[i] = erase_range(n->children[i], b, e);
+    }
+    // Drop empty leaf children (lazy structural cleanup).
+    for (size_t i = 0; i < n->children.size() && n->children.size() > 1;) {
+      Node* c = load(n->children[i]);
+      if (c && c->keys.empty() && c->leaf) {
+        free_child(n->children[i]);
+        n->children.erase(n->children.begin() + i);
+        n->keys.erase(n->keys.begin() + (i == 0 ? 0 : i - 1));
+      } else {
+        i++;
+      }
+    }
+    return did;
+  }
+
+  void free_child(int64_t id) {
+    if (id < 0) dirty_.erase(id);
+    else {
+      std::vector<int64_t> pages;
+      free_pages_of(id, pages);
+      for (auto p : pages) pending_free_.push_back(p);
+      cache_.erase(id);
+    }
+  }
+
+  void scan(int64_t id, const std::string& b, const std::string& e,
+            uint64_t limit, std::vector<std::pair<std::string, std::string>>& out) {
+    if (limit && out.size() >= limit) return;
+    Node* n = load(id);
+    if (!n) return;
+    if (n->leaf) {
+      auto lo = std::lower_bound(n->keys.begin(), n->keys.end(), b);
+      for (size_t i = lo - n->keys.begin(); i < n->keys.size(); i++) {
+        if (!(n->keys[i] < e)) return;
+        out.emplace_back(n->keys[i], n->values[i]);
+        if (limit && out.size() >= limit) return;
+      }
+      return;
+    }
+    for (size_t i = 0; i < n->children.size(); i++) {
+      bool lo_ok = (i == 0) || (n->keys[i - 1] < e);
+      bool hi_ok = (i == n->keys.size()) || !(n->keys[i] < b);
+      if (lo_ok && hi_ok) scan(n->children[i], b, e, limit, out);
+      if (limit && out.size() >= limit) return;
+    }
+  }
+
+  std::string path_;
+  int fd_ = -1;
+  uint64_t generation_ = 0;
+  uint64_t page_count_ = 2;
+  int64_t root_id_ = -1;
+  int64_t free_blob_ = -1;
+  int64_t next_temp_ = -2;
+  std::map<int64_t, std::unique_ptr<Node>> dirty_;
+  std::map<int64_t, std::unique_ptr<Node>> cache_;
+  std::map<int64_t, std::vector<int64_t>> blob_pages_;
+  std::vector<int64_t> free_, pending_free_, alloc_pool_;
+  std::set<int64_t> allocated_set_;
+  bool corrupt_ = false;
+};
+
+}  // namespace
+
+// ---- C ABI ----
+extern "C" {
+
+void* btree_open(const char* path) {
+  auto* kvs = new BTreeKVS(path);
+  if (!kvs->open()) { delete kvs; return nullptr; }
+  return kvs;
+}
+
+void btree_close(void* h) {
+  auto* kvs = (BTreeKVS*)h;
+  kvs->close();
+  delete kvs;
+}
+
+void btree_set(void* h, const uint8_t* k, uint32_t klen, const uint8_t* v,
+               uint32_t vlen) {
+  ((BTreeKVS*)h)->set(std::string((const char*)k, klen),
+                      std::string((const char*)v, vlen));
+}
+
+void btree_clear_range(void* h, const uint8_t* b, uint32_t blen,
+                       const uint8_t* e, uint32_t elen) {
+  ((BTreeKVS*)h)->clear_range(std::string((const char*)b, blen),
+                              std::string((const char*)e, elen));
+}
+
+int btree_commit(void* h) { return ((BTreeKVS*)h)->commit() ? 0 : -1; }
+
+// get: returns 1 if found; result copied into a per-handle buffer.
+static thread_local std::string g_val;
+// 1 = found, 0 = absent, -1 = corruption detected (io_error).
+int btree_get(void* h, const uint8_t* k, uint32_t klen, const uint8_t** out,
+              uint32_t* out_len) {
+  auto* kvs = (BTreeKVS*)h;
+  bool found = kvs->get(std::string((const char*)k, klen), g_val);
+  if (kvs->corrupt()) return -1;
+  if (!found) return 0;
+  *out = (const uint8_t*)g_val.data();
+  *out_len = (uint32_t)g_val.size();
+  return 1;
+}
+
+int btree_corrupt(void* h) { return ((BTreeKVS*)h)->corrupt() ? 1 : 0; }
+
+// range read via cursor-over-materialized-result (bounded by limit).
+struct RangeResult {
+  std::vector<std::pair<std::string, std::string>> rows;
+  size_t pos = 0;
+};
+
+void* btree_read_range(void* h, const uint8_t* b, uint32_t blen,
+                       const uint8_t* e, uint32_t elen, uint64_t limit) {
+  auto* rr = new RangeResult();
+  ((BTreeKVS*)h)->read_range(std::string((const char*)b, blen),
+                             std::string((const char*)e, elen), limit,
+                             rr->rows);
+  return rr;
+}
+
+int btree_range_next(void* rr_, const uint8_t** k, uint32_t* klen,
+                     const uint8_t** v, uint32_t* vlen) {
+  auto* rr = (RangeResult*)rr_;
+  if (rr->pos >= rr->rows.size()) return 0;
+  auto& row = rr->rows[rr->pos++];
+  *k = (const uint8_t*)row.first.data();
+  *klen = (uint32_t)row.first.size();
+  *v = (const uint8_t*)row.second.data();
+  *vlen = (uint32_t)row.second.size();
+  return 1;
+}
+
+void btree_range_close(void* rr_) { delete (RangeResult*)rr_; }
+
+uint64_t btree_page_count(void* h) { return ((BTreeKVS*)h)->page_count(); }
+uint64_t btree_free_pages(void* h) { return ((BTreeKVS*)h)->free_pages(); }
+
+}  // extern "C"

@@ -1,0 +1,173 @@
+"""ssd engine tests: the native COW B+tree (ref: the reference's ssd
+engine contract — durable committed state, torn-write safety, large
+key/value fragmentation, space reuse)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from foundationdb_tpu.storage_engine.ssd_engine import KeyValueStoreSSD
+
+
+def _path(tmp_path, name="kvs.db"):
+    return str(tmp_path / name)
+
+
+def test_basic_crud_and_range(tmp_path):
+    kvs = KeyValueStoreSSD(_path(tmp_path))
+    for i in range(2000):
+        kvs.set(b"k%05d" % i, b"v%d" % i)
+    kvs.commit()
+    assert kvs.get(b"k00042") == b"v42"
+    assert kvs.get(b"missing") is None
+    rows = kvs.get_range(b"k00010", b"k00013")
+    assert rows == [(b"k00010", b"v10"), (b"k00011", b"v11"),
+                    (b"k00012", b"v12")]
+    assert len(kvs.get_range(b"", b"\xff", limit=5)) == 5
+    kvs.clear_range(b"k00010", b"k01000")
+    kvs.commit()
+    assert kvs.get(b"k00500") is None
+    assert kvs.get(b"k01500") == b"v1500"
+    kvs.close()
+
+
+def test_recovery_after_clean_close(tmp_path):
+    p = _path(tmp_path)
+    kvs = KeyValueStoreSSD(p)
+    for i in range(500):
+        kvs.set(b"a%04d" % i, b"x" * 100)
+    kvs.commit()
+    kvs.close()
+    kvs2 = KeyValueStoreSSD(p)
+    assert kvs2.get(b"a0123") == b"x" * 100
+    assert len(kvs2.get_range(b"", b"\xff")) == 500
+    kvs2.close()
+
+
+def test_uncommitted_writes_lost_on_crash(tmp_path):
+    """Kill-without-commit in a subprocess: the committed tree must be
+    intact, the uncommitted writes gone (the COW/dual-header guarantee)."""
+    p = _path(tmp_path)
+    code = f"""
+import sys, os
+sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+from foundationdb_tpu.storage_engine.ssd_engine import KeyValueStoreSSD
+kvs = KeyValueStoreSSD({p!r})
+for i in range(100):
+    kvs.set(b"committed%03d" % i, b"yes")
+kvs.commit()
+for i in range(100):
+    kvs.set(b"uncommitted%03d" % i, b"no")
+os._exit(9)  # die without commit/close
+"""
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True)
+    assert r.returncode == 9
+    kvs = KeyValueStoreSSD(p)
+    assert kvs.get(b"committed050") == b"yes"
+    assert kvs.get(b"uncommitted050") is None
+    assert len(kvs.get_range(b"", b"\xff")) == 100
+    kvs.close()
+
+
+def test_torn_header_falls_back_to_previous_generation(tmp_path):
+    p = _path(tmp_path)
+    kvs = KeyValueStoreSSD(p)
+    kvs.set(b"gen1", b"a")
+    kvs.commit()
+    kvs.set(b"gen2", b"b")
+    kvs.commit()
+    kvs.close()
+    # Corrupt the newer header page (generation 3 used header 3%2=1...
+    # flip bytes in BOTH headers' CRC region one at a time and ensure the
+    # other generation still opens).
+    with open(p, "r+b") as f:
+        f.seek(4096 + 16)  # header 1's body
+        f.write(b"\xde\xad\xbe\xef")
+    kvs2 = KeyValueStoreSSD(p)
+    # Whichever header survived, gen1's data exists (gen2 may or may not,
+    # depending on which header was newest) and the store opens cleanly.
+    assert kvs2.get(b"gen1") == b"a"
+    kvs2.close()
+
+
+def test_large_values_and_keys_fragment_across_pages(tmp_path):
+    kvs = KeyValueStoreSSD(_path(tmp_path))
+    big_val = os.urandom(100_000)  # VALUE_SIZE_LIMIT
+    big_key = b"K" * 10_000        # KEY_SIZE_LIMIT
+    kvs.set(b"big", big_val)
+    kvs.set(big_key, b"v")
+    kvs.commit()
+    kvs.close()
+    kvs2 = KeyValueStoreSSD(_path(tmp_path))
+    assert kvs2.get(b"big") == big_val
+    assert kvs2.get(big_key) == b"v"
+    kvs2.close()
+
+
+def test_space_reuse_via_free_list(tmp_path):
+    kvs = KeyValueStoreSSD(_path(tmp_path))
+    for i in range(1000):
+        kvs.set(b"k%04d" % i, b"x" * 200)
+    kvs.commit()
+    pages_after_load = kvs.page_count()
+    # Overwrite the same keys many times: COW must recycle freed pages
+    # instead of growing the file unboundedly (springCleaning's point).
+    for round_ in range(10):
+        for i in range(0, 1000, 50):
+            kvs.set(b"k%04d" % i, b"y" * 200)
+        kvs.commit()
+    growth = kvs.page_count() - pages_after_load
+    assert growth < 300, f"file grew by {growth} pages despite free list"
+    kvs.close()
+
+
+def test_overwrites_and_interleaved_commits(tmp_path):
+    kvs = KeyValueStoreSSD(_path(tmp_path))
+    kvs.set(b"k", b"v1")
+    assert kvs.get(b"k") == b"v1"  # visible before commit
+    kvs.commit()
+    kvs.set(b"k", b"v2")
+    assert kvs.get(b"k") == b"v2"
+    kvs.clear(b"k")
+    assert kvs.get(b"k") is None
+    kvs.commit()
+    kvs.close()
+    kvs2 = KeyValueStoreSSD(_path(tmp_path))
+    assert kvs2.get(b"k") is None
+    kvs2.close()
+
+
+def test_detected_corruption_raises_not_silently_missing(tmp_path):
+    """A checksum failure must surface as IoError — never as 'key not
+    found' or a truncated range (detected corruption becoming silent data
+    loss defeats the checksums)."""
+    from foundationdb_tpu.core.errors import IoError
+
+    p = _path(tmp_path)
+    kvs = KeyValueStoreSSD(p)
+    for i in range(2000):
+        kvs.set(b"k%05d" % i, b"v" * 50)
+    kvs.commit()
+    kvs.close()
+    # Corrupt a CRC-covered header field (the generation word) of every
+    # data page — padding bytes are outside the checksum, so a random
+    # flip could land harmlessly.
+    size = os.path.getsize(p)
+    with open(p, "r+b") as f:
+        for page in range(2, size // 4096):
+            f.seek(page * 4096 + 9)
+            b = f.read(1)
+            f.seek(page * 4096 + 9)
+            f.write(bytes([b[0] ^ 0xFF]))
+    # Detected corruption surfaces as IoError — at open (free-list blob
+    # unreadable) or on the first read that crosses a bad page — never as
+    # empty/partial results.
+    with pytest.raises(IoError):
+        kvs2 = KeyValueStoreSSD(p)
+        try:
+            rows = kvs2.get_range(b"", b"\xff")
+            assert not rows or len(rows) == 2000, "partial silent results"
+        finally:
+            kvs2.close()
