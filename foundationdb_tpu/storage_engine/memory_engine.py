@@ -45,8 +45,10 @@ def _unrec(data: bytes) -> tuple[int, bytes, bytes]:
 
 
 class KeyValueStoreMemory:
-    def __init__(self, path_prefix: str, backend: Optional[str] = None):
-        self.queue = DiskQueue(path_prefix, backend=backend)
+    def __init__(self, path_prefix: str, backend: Optional[str] = None,
+                 os_layer=None):
+        self.queue = DiskQueue(path_prefix, backend=backend,
+                               os_layer=os_layer)
         self._keys: list[bytes] = []
         self._map: dict[bytes, bytes] = {}
         self._bytes_since_snapshot = 0
