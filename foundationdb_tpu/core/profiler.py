@@ -42,6 +42,9 @@ class Profiler:
     def start(self, interval: float = 0.01) -> None:
         assert not self._running
         self._running = True
+        # A prior fallback must not leak: re-arm PROF first every time
+        # (SIGPROF handler + ITIMER_REAL would deliver unhandled SIGALRM).
+        self._timer = signal.ITIMER_PROF
         sig = signal.SIGPROF
         try:
             self._prev_handler = signal.signal(sig, self._handler)

@@ -1,0 +1,249 @@
+// fdbtpu_monitor: plain-C++ process supervisor (ref:
+// fdbmonitor/fdbmonitor.cpp — parses foundationdb.conf, spawns/restarts
+// fdbserver children with backoff, reloads the conf on change, forwards
+// termination signals; no flow runtime, deliberately).
+//
+// Conf format (ini, like the reference's foundationdb.conf:33):
+//   [general]
+//   restart_delay = 5        ; max backoff seconds
+//   conf_poll_seconds = 1
+//   [process.NAME]
+//   command = /usr/bin/python3 -m something --flag
+//
+// Each [process.*] section runs one child. Exits trigger restart with
+// exponential backoff up to restart_delay (reset after a healthy minute).
+// Conf changes (mtime poll — inotify-free for portability) start new
+// sections, kill removed ones, and restart changed commands. SIGTERM/
+// SIGINT terminate all children then exit.
+
+#include <csignal>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+#include <fstream>
+#include <map>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+namespace {
+
+volatile sig_atomic_t g_shutdown = 0;
+void on_term(int) { g_shutdown = 1; }
+
+struct ProcConf {
+  std::string command;
+};
+
+struct Child {
+  pid_t pid = -1;
+  std::string command;
+  double backoff = 0.25;
+  time_t started_at = 0;
+  double restart_at = 0;  // monotonic deadline; 0 = running/none pending
+};
+
+double now_mono() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec + ts.tv_nsec * 1e-9;
+}
+
+// SIGTERM, escalate to SIGKILL after grace (ref: fdbmonitor's kill path).
+void stop_child(pid_t pid, double grace = 5.0) {
+  kill(pid, SIGTERM);
+  double deadline = now_mono() + grace;
+  int status;
+  while (now_mono() < deadline) {
+    if (waitpid(pid, &status, WNOHANG) == pid) return;
+    usleep(20000);
+  }
+  kill(pid, SIGKILL);
+  waitpid(pid, &status, 0);
+}
+
+std::string trim(const std::string& s) {
+  size_t a = s.find_first_not_of(" \t\r\n");
+  if (a == std::string::npos) return "";
+  size_t b = s.find_last_not_of(" \t\r\n");
+  return s.substr(a, b - a + 1);
+}
+
+// returns (general settings, process sections)
+bool parse_conf(const std::string& path,
+                std::map<std::string, std::string>& general,
+                std::map<std::string, ProcConf>& procs) {
+  std::ifstream in(path);
+  if (!in) return false;
+  std::string line, section;
+  while (std::getline(in, line)) {
+    size_t semi = line.find(';');
+    if (semi != std::string::npos) line = line.substr(0, semi);
+    line = trim(line);
+    if (line.empty()) continue;
+    if (line.front() == '[' && line.back() == ']') {
+      section = line.substr(1, line.size() - 2);
+      continue;
+    }
+    size_t eq = line.find('=');
+    if (eq == std::string::npos) continue;
+    std::string key = trim(line.substr(0, eq));
+    std::string val = trim(line.substr(eq + 1));
+    if (section == "general") {
+      general[key] = val;
+    } else if (section.rfind("process.", 0) == 0) {
+      if (key == "command") procs[section.substr(8)].command = val;
+    }
+  }
+  return true;
+}
+
+std::vector<std::string> split_args(const std::string& cmd) {
+  std::vector<std::string> out;
+  std::istringstream ss(cmd);
+  std::string tok;
+  while (ss >> tok) out.push_back(tok);
+  return out;
+}
+
+pid_t spawn(const std::string& command) {
+  auto args = split_args(command);
+  if (args.empty()) return -1;
+  pid_t pid = fork();
+  if (pid != 0) return pid;
+  // child
+  std::vector<char*> argv;
+  for (auto& a : args) argv.push_back(const_cast<char*>(a.c_str()));
+  argv.push_back(nullptr);
+  execvp(argv[0], argv.data());
+  fprintf(stderr, "fdbtpu_monitor: exec %s failed: %s\n", argv[0],
+          strerror(errno));
+  _exit(127);
+}
+
+time_t mtime_of(const std::string& path) {
+  struct stat st;
+  return stat(path.c_str(), &st) == 0 ? st.st_mtime : 0;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: fdbtpu_monitor <conf> [--lockfile ignored]\n");
+    return 2;
+  }
+  std::string conf_path = argv[1];
+  signal(SIGTERM, on_term);
+  signal(SIGINT, on_term);
+
+  std::map<std::string, std::string> general;
+  std::map<std::string, ProcConf> procs;
+  if (!parse_conf(conf_path, general, procs)) {
+    fprintf(stderr, "fdbtpu_monitor: cannot read %s\n", conf_path.c_str());
+    return 2;
+  }
+  double max_backoff = general.count("restart_delay")
+                           ? atof(general["restart_delay"].c_str())
+                           : 5.0;
+  double poll = general.count("conf_poll_seconds")
+                    ? atof(general["conf_poll_seconds"].c_str())
+                    : 1.0;
+  time_t conf_mtime = mtime_of(conf_path);
+
+  std::map<std::string, Child> children;
+  auto start = [&](const std::string& name, const std::string& cmd) {
+    Child& c = children[name];
+    c.command = cmd;
+    c.pid = spawn(cmd);
+    c.started_at = time(nullptr);
+    printf("fdbtpu_monitor: started %s pid=%d (%s)\n", name.c_str(),
+           (int)c.pid, cmd.c_str());
+    fflush(stdout);
+  };
+  for (auto& [name, pc] : procs) start(name, pc.command);
+
+  while (!g_shutdown) {
+    // Reap exits; SCHEDULE restarts (never sleep in the reap loop — one
+    // crash-looping child must not stall the others or conf polling).
+    int status;
+    pid_t dead;
+    while ((dead = waitpid(-1, &status, WNOHANG)) > 0) {
+      for (auto& [name, c] : children) {
+        if (c.pid != dead) continue;
+        double healthy_secs = difftime(time(nullptr), c.started_at);
+        if (healthy_secs > 60) c.backoff = 0.25;  // stability resets it
+        printf("fdbtpu_monitor: %s pid=%d exited status=%d; restart in %.2fs\n",
+               name.c_str(), (int)dead, status, c.backoff);
+        fflush(stdout);
+        c.pid = -1;
+        c.restart_at = now_mono() + c.backoff;
+        c.backoff = std::min(c.backoff * 2, max_backoff);
+      }
+    }
+    // Start children whose backoff deadline passed.
+    for (auto& [name, c] : children) {
+      if (c.pid < 0 && c.restart_at > 0 && now_mono() >= c.restart_at &&
+          procs.count(name)) {
+        c.restart_at = 0;
+        start(name, procs[name].command);
+      }
+    }
+    // Conf reload on mtime change (ref: fdbmonitor's inotify watch :638;
+    // polling keeps this portable).
+    time_t mt = mtime_of(conf_path);
+    if (mt != conf_mtime) {
+      conf_mtime = mt;
+      std::map<std::string, std::string> g2;
+      std::map<std::string, ProcConf> p2;
+      if (parse_conf(conf_path, g2, p2)) {
+        for (auto& [name, c] : children) {
+          bool gone = !p2.count(name);
+          bool changed = !gone && p2[name].command != c.command;
+          if ((gone || changed) && c.pid > 0) {
+            printf("fdbtpu_monitor: conf change, stopping %s pid=%d\n",
+                   name.c_str(), (int)c.pid);
+            fflush(stdout);
+            stop_child(c.pid);
+            c.pid = -1;
+          }
+        }
+        for (auto& [name, pc] : p2) {
+          if (!children.count(name) || children[name].pid <= 0)
+            start(name, pc.command);
+        }
+        for (auto it = children.begin(); it != children.end();) {
+          if (!p2.count(it->first)) it = children.erase(it);
+          else ++it;
+        }
+        procs = p2;
+      }
+    }
+    usleep((useconds_t)(poll * 1e6));
+  }
+
+  // Shutdown: terminate every child in parallel, escalate stragglers.
+  for (auto& [name, c] : children)
+    if (c.pid > 0) kill(c.pid, SIGTERM);
+  double deadline = now_mono() + 5.0;
+  for (auto& [name, c] : children) {
+    if (c.pid <= 0) continue;
+    int status;
+    while (now_mono() < deadline) {
+      if (waitpid(c.pid, &status, WNOHANG) == c.pid) { c.pid = -1; break; }
+      usleep(20000);
+    }
+    if (c.pid > 0) {
+      kill(c.pid, SIGKILL);
+      waitpid(c.pid, &status, 0);
+    }
+  }
+  printf("fdbtpu_monitor: shutdown complete\n");
+  return 0;
+}

@@ -1,0 +1,105 @@
+"""fdbtpu_monitor supervisor tests (ref: fdbmonitor/fdbmonitor.cpp —
+spawn, restart-with-backoff, conf reload, clean shutdown). Real
+processes, real signals; marked slow-ish but bounded."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+MONITOR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "native", "fdbtpu_monitor",
+)
+
+
+def _wait_for(predicate, timeout=10.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture()
+def monitor_conf(tmp_path):
+    beat = tmp_path / "beat"
+    # A tiny worker script (no shell quoting in the conf's command line):
+    # appends its pid to a beat file, then sleeps forever.
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os, sys, time\n"
+        "open(sys.argv[1], 'a').write(str(os.getpid()) + '\\n')\n"
+        "time.sleep(3600)\n"
+    )
+    conf = tmp_path / "monitor.conf"
+    conf.write_text(
+        "[general]\n"
+        "restart_delay = 1\n"
+        "conf_poll_seconds = 0.1\n"
+        "[process.alpha]\n"
+        f"command = {sys.executable} {script} {beat}.alpha\n"
+        "[process.beta]\n"
+        f"command = {sys.executable} {script} {beat}.beta\n"
+    )
+    return conf, beat, script
+
+
+def _pids(path):
+    try:
+        with open(path) as f:
+            return [int(x) for x in f.read().split()]
+    except FileNotFoundError:
+        return []
+
+
+def test_monitor_spawns_restarts_and_reloads(monitor_conf):
+    conf, beat, script = monitor_conf
+    if not os.path.exists(MONITOR):
+        pytest.skip("fdbtpu_monitor not built")
+    mon = subprocess.Popen(
+        [MONITOR, str(conf)], stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        assert _wait_for(lambda: _pids(f"{beat}.alpha") and _pids(f"{beat}.beta"))
+        alpha_pid = _pids(f"{beat}.alpha")[-1]
+
+        # Kill alpha: the monitor must restart it (new pid appears).
+        os.kill(alpha_pid, signal.SIGKILL)
+        assert _wait_for(lambda: len(_pids(f"{beat}.alpha")) >= 2), (
+            "child was not restarted"
+        )
+        assert _pids(f"{beat}.alpha")[-1] != alpha_pid
+
+        # Conf reload: drop beta, add gamma.
+        beta_pid = _pids(f"{beat}.beta")[-1]
+        conf.write_text(
+            "[general]\nrestart_delay = 1\nconf_poll_seconds = 0.1\n"
+            "[process.alpha]\n"
+            f"command = {sys.executable} {script} {beat}.alpha\n"
+            "[process.gamma]\n"
+            f"command = {sys.executable} {script} {beat}.gamma\n"
+        )
+        assert _wait_for(lambda: _pids(f"{beat}.gamma")), "new section not started"
+
+        def beta_dead():
+            try:
+                os.kill(beta_pid, 0)
+                return False
+            except ProcessLookupError:
+                return True
+
+        assert _wait_for(beta_dead), "removed section's child still alive"
+    finally:
+        mon.terminate()
+        mon.wait(timeout=10)
+    # Clean shutdown: all children gone.
+    for name in ("alpha", "gamma"):
+        for pid in _pids(f"{beat}.{name}"):
+            with pytest.raises(ProcessLookupError):
+                os.kill(pid, 0)
