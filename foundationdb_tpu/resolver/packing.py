@@ -29,8 +29,13 @@ per-batch tensors are therefore packed host-side into a single int32 vector
 with a static layout (FusedLayout) and unpacked on device with static
 slices, giving exactly one H2D transfer per resolve.
 
-Batch tensors are padded to power-of-two capacities so jit re-specializes on
-a small number of shape buckets (SURVEY.md §7 "batch-size bucketing").
+Batch tensors are padded to mantissa buckets (m * 2^k, m in [8, 15] — see
+next_bucket) so jit re-specializes on a bounded set of shape buckets while
+capping padding waste at 12.5% per dimension (SURVEY.md §7 "batch-size
+bucketing"; pure pow2 rounding wasted up to 2x per dimension, compounding
+into the endpoint space). Finer buckets mean more first-encounter compiles
+than pow2 (8 per octave per dimension): deployments warm their expected
+batch footprints via ConflictSetTPU.warmup.
 """
 
 from __future__ import annotations
@@ -61,13 +66,29 @@ def next_pow2(x: int, minimum: int = 8) -> int:
     return n
 
 
+def next_bucket(x: int, minimum: int = 8) -> int:
+    """Smallest m * 2^k >= x with m in [8, 15]: 8 shape buckets per octave,
+    <= 12.5% padding waste. Pure power-of-two rounding wastes up to 2x on
+    every padded dimension, and the waste COMPOUNDS into the endpoint
+    space (P2 ~ 2*(R+Wr)) — on a link charging ~50-90 ms/MB that is the
+    single largest avoidable cost in a resolve. Kernel shapes only need
+    consistency, not powers of two (the segment tree and scans are
+    size-generic); the conflict-set CAPACITY stays pow2 for the rank
+    probe's halving walk."""
+    if x <= minimum:
+        return minimum
+    k = max(0, (x - 1).bit_length() - 4)
+    m = -(-x >> k)  # ceil(x / 2^k)
+    return m << k
+
+
 def pack_keys(keys: Sequence[bytes], n_words: int) -> tuple[np.ndarray, np.ndarray]:
     """Pack keys into (N, n_words) biased-int32 big-endian words + (N,)
     int32 lengths. Fully vectorized: one concatenation + one masked scatter,
-    no per-key Python loop."""
+    no per-key Python loop (map(len, ·) runs in C)."""
     width = 4 * n_words
     n = len(keys)
-    lens = np.fromiter((len(k) for k in keys), dtype=np.int32, count=n)
+    lens = np.fromiter(map(len, keys), dtype=np.int32, count=n)
     if n and int(lens.max()) > width:
         bad = int(lens.max())
         raise KeyWidthError(f"key of {bad} bytes exceeds packed width {width}")
@@ -145,27 +166,30 @@ def flatten_batch(txns: Sequence[TxnConflictInfo], oldest_version: int):
     too_old_l = [
         t.read_snapshot < oldest_version and len(t.read_ranges) > 0 for t in txns
     ]
-    r_begin: list[bytes] = []
-    r_end: list[bytes] = []
-    r_txn: list[int] = []
-    r_snap: list[int] = []
-    w_begin: list[bytes] = []
-    w_end: list[bytes] = []
-    w_txn: list[int] = []
-    for i, t in enumerate(txns):
-        if too_old_l[i]:
-            continue
-        for r in t.read_ranges:
-            if not r.is_empty():
-                r_begin.append(r.begin)
-                r_end.append(r.end)
-                r_txn.append(i)
-                r_snap.append(t.read_snapshot)
-        for w in t.write_ranges:
-            if not w.is_empty():
-                w_begin.append(w.begin)
-                w_end.append(w.end)
-                w_txn.append(i)
+    # Comprehension-built rows (C-speed iteration; ~2x the append loop at
+    # 64K-txn batches, which sits on the commit critical path).
+    live = [
+        (i, t) for i, t in enumerate(txns) if not too_old_l[i]
+    ]
+    r_rows = [
+        (i, t.read_snapshot, r.begin, r.end)
+        for i, t in live
+        for r in t.read_ranges
+        if r.begin < r.end
+    ]
+    w_rows = [
+        (i, w.begin, w.end)
+        for i, t in live
+        for w in t.write_ranges
+        if w.begin < w.end
+    ]
+    r_txn = [x[0] for x in r_rows]
+    r_snap = [x[1] for x in r_rows]
+    r_begin = [x[2] for x in r_rows]
+    r_end = [x[3] for x in r_rows]
+    w_txn = [x[0] for x in w_rows]
+    w_begin = [x[1] for x in w_rows]
+    w_end = [x[2] for x in w_rows]
     return too_old_l, r_begin, r_end, r_txn, r_snap, w_begin, w_end, w_txn
 
 
@@ -270,44 +294,51 @@ def pack_batch(
     )
 
     min_r, min_w, min_t = caps if caps is not None else (0, 0, 0)
-    R = next_pow2(max(len(r_begin), min_r))
-    Wr = next_pow2(max(len(w_begin), min_w))
-    T = next_pow2(max(n_txns, min_t))
-    P = 2 * R + 2 * Wr
-    P2 = next_pow2(P)
     nr, nw = len(r_begin), len(w_begin)
+    R = next_bucket(max(nr, min_r))
+    Wr = next_bucket(max(nw, min_w))
+    T = next_bucket(max(n_txns, min_t))
+    # Endpoint space sized from the PADDED segments (position invariants:
+    # every padded row owns a distinct endpoint slot).
+    P = 2 * R + 2 * Wr
+    P2 = next_bucket(P)
 
-    def padded_keys(keys: list[bytes], cap: int):
-        words, lens = pack_keys(keys, n_words)
-        pw = np.full((cap, n_words), PAD_WORD, dtype=np.int32)
-        pl = np.full(cap, INT32_MAX, dtype=np.int32)
-        pw[: len(keys)] = words
-        pl[: len(keys)] = lens
-        return pw, pl
-
-    rbw, rbl = padded_keys(r_begin, R)
-    rew, rel = padded_keys(r_end, R)
-    wbw, wbl = padded_keys(w_begin, Wr)
-    wew, wel = padded_keys(w_end, Wr)
-
-    # Concatenation order [r_end, w_end, w_begin, r_begin] = tag order.
-    words = np.concatenate([rew, wew, wbw, rbw])
-    lens = np.concatenate([rel, wel, wbl, rbl])
+    # Sort ONLY the real endpoint rows (2nr+2nw); pad rows are all-max
+    # keys that a full lexsort would place after every real key in tag
+    # blocks anyway (stable sort, equal keys, len<<3|tag tiebreak), so
+    # their positions are assigned arithmetically below — sorting up to
+    # 2x fewer rows on the commit critical path.
+    P_act = 2 * nr + 2 * nw
+    words, lens = pack_keys(
+        r_end + w_end + w_begin + r_begin, n_words
+    )
     tags = np.concatenate(
         [
-            np.full(R, TAG_RE, np.int32),
-            np.full(Wr, TAG_WE, np.int32),
-            np.full(Wr, TAG_WB, np.int32),
-            np.full(R, TAG_RB, np.int32),
+            np.full(nr, TAG_RE, np.int32),
+            np.full(nw, TAG_WE, np.int32),
+            np.full(nw, TAG_WB, np.int32),
+            np.full(nr, TAG_RB, np.int32),
         ]
     )
     # Sort by (words..., len, tag); np.lexsort's primary key is the LAST.
+    # Adjacent word pairs compose into host-side uint64 keys (unsigned raw
+    # byte order == the biased-int32 order the device uses), halving the
+    # lexsort passes — int64 is fine on HOST, it is only the device that
+    # lacks it.
     lt = (lens.astype(np.int64) << 3) | tags.astype(np.int64)
-    order = np.lexsort(
-        (lt,) + tuple(words[:, j] for j in reversed(range(n_words)))
-    )
-    inv = np.empty(P, np.int32)
-    inv[order] = np.arange(P, dtype=np.int32)
+    raw = words.view(np.uint32) ^ np.uint32(0x80000000)
+    pair_keys = []
+    for j in range(0, n_words, 2):
+        hi = raw[:, j].astype(np.uint64) << np.uint64(32)
+        lo = (
+            raw[:, j + 1].astype(np.uint64)
+            if j + 1 < n_words
+            else np.uint64(0)
+        )
+        pair_keys.append(hi | lo)
+    order = np.lexsort((lt,) + tuple(reversed(pair_keys)))
+    inv = np.empty(P_act, np.int32)
+    inv[order] = np.arange(P_act, dtype=np.int32)
 
     lay = FusedLayout(n_words, P2, R, Wr, T)
     buf = np.zeros(lay.total, dtype=np.int32)
@@ -315,13 +346,27 @@ def pack_batch(
     smat = buf[lay.off_smat : lay.off_smat + W1 * P2].reshape(W1, P2)
     smat[:n_words, :] = PAD_WORD
     smat[n_words, :] = INT32_MAX
-    smat[:n_words, :P] = words[order].T
-    smat[n_words, :P] = lens[order]
+    smat[:n_words, :P_act] = words[order].T
+    smat[n_words, :P_act] = lens[order]
 
-    buf[lay.off_q_end : lay.off_q_end + R] = inv[:R]
-    buf[lay.off_s_end : lay.off_s_end + Wr] = inv[R : R + Wr]
-    buf[lay.off_s_begin : lay.off_s_begin + Wr] = inv[R + Wr : R + 2 * Wr]
-    buf[lay.off_q_begin : lay.off_q_begin + R] = inv[R + 2 * Wr :]
+    # Pad endpoint positions: the tag-ordered blocks right after P_act —
+    # exactly where the full padded lexsort used to place them.
+    pr, pw_ = R - nr, Wr - nw  # pad row counts per read/write segment
+    ar = np.arange
+    buf[lay.off_q_end : lay.off_q_end + nr] = inv[:nr]
+    buf[lay.off_q_end + nr : lay.off_q_end + R] = P_act + ar(pr, dtype=np.int32)
+    buf[lay.off_s_end : lay.off_s_end + nw] = inv[nr : nr + nw]
+    buf[lay.off_s_end + nw : lay.off_s_end + Wr] = (
+        P_act + pr + ar(pw_, dtype=np.int32)
+    )
+    buf[lay.off_s_begin : lay.off_s_begin + nw] = inv[nr + nw : nr + 2 * nw]
+    buf[lay.off_s_begin + nw : lay.off_s_begin + Wr] = (
+        P_act + pr + pw_ + ar(pw_, dtype=np.int32)
+    )
+    buf[lay.off_q_begin : lay.off_q_begin + nr] = inv[nr + 2 * nw :]
+    buf[lay.off_q_begin + nr : lay.off_q_begin + R] = (
+        P_act + pr + 2 * pw_ + ar(pr, dtype=np.int32)
+    )
 
     rtxn = buf[lay.off_rtxn : lay.off_rtxn + R]
     rtxn[:nr] = r_txn
