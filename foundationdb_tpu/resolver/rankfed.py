@@ -1,0 +1,593 @@
+"""Rank-fed conflict kernel: keys never cross the host-device link.
+
+The classic kernel (tpu.py) ships every endpoint KEY to the device and
+binary-searches the resident key matrix there. On the dev tunnel that is
+the wrong trade: H2D bandwidth (~10-30 MB/s measured) and the per-op
+dispatch floor dominate, and key words are ~2/3 of the batch buffer while
+the 20-step on-device rank probe is ~1/3 of the device time.
+
+This kernel moves ALL key work to the host (ref: the reference resolver
+also keys its skip list on the host CPU — SkipList.cpp:524):
+
+- The host keeps a SORTED MIRROR of the history's keys (fixed-width
+  byte-encoded, numpy 'S' dtype, memcmp order == the packed word order),
+  always exactly aligned with the device's version vector by position.
+- Every rank the device used to compute — read-begin/end history ranks
+  (phase 1), write-endpoint merge ranks (phase 3), case A/B geometry
+  (phase 2) — is an np.searchsorted on the host, shipped as int32.
+- The device state is ONE (C,) int32 version vector. No keys on device,
+  no key gathers, no rank probe: device work is the version range-max,
+  the intra-batch fixed point, and the merge scatter.
+
+Alignment without per-batch sync — the SUPERSET insert: every write
+endpoint of the batch is inserted into mirror and device state alike,
+committed or not. An endpoint of an uncommitted (or conflicting) write
+takes its predecessor's value, which leaves the step FUNCTION unchanged —
+so correctness never depends on knowing the verdicts host-side, and the
+host can pack batch k+1 the moment batch k is packed (full pipelining).
+The cost is capacity: duplicates and no-op entries accumulate until a GC
+ROUND (amortized, one D2H of the version vector every ~C/4Wr batches)
+re-canonicalizes both sides to the oracle's minimal step function.
+
+Differential contract: statuses AND canonicalized entries() match
+ConflictSetCPU bit-for-bit (tests/test_conflict_rankfed.py).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .packing import BIAS, next_pow2, pack_keys
+from .types import COMMITTED, CONFLICT, TOO_OLD, ConflictBatchResult, TxnConflictInfo
+
+_I32_INF = jnp.int32(2**31 - 1)
+INT32_MAX = np.int32(2**31 - 1)
+
+
+# ---------------------------------------------------------------------------
+# Host-side key encoding: fixed-width bytes whose memcmp order equals the
+# (words..., len) tuple order (big-endian unsigned words, big-endian u32
+# length) — the same total order the classic kernel compares in int32.
+# ---------------------------------------------------------------------------
+
+def encode_keys(keys: Sequence[bytes], n_words: int) -> np.ndarray:
+    words, lens = pack_keys(keys, n_words)
+    n = len(keys)
+    # Concatenate at the BYTE level: np.concatenate silently normalizes
+    # byteswapped dtypes to native order, which would scramble the memcmp
+    # encoding.
+    raw = (
+        (words.view(np.uint32) ^ np.uint32(0x80000000))
+        .astype(">u4").view(np.uint8).reshape(n, 4 * n_words)
+    )
+    lens_b = lens.astype(">u4").view(np.uint8).reshape(n, 4)
+    buf = np.concatenate([raw, lens_b], axis=1)
+    return np.ascontiguousarray(buf).view(f"S{4 * (n_words + 1)}").reshape(-1)
+
+
+def widen_encoded(enc: np.ndarray, old_words: int, new_words: int) -> np.ndarray:
+    """Re-encode a mirror at a wider word count WITHOUT decoding: insert
+    zero words between the old words and the length (packed keys are
+    zero-padded, so the extra words are raw 0x00000000 big-endian)."""
+    a = enc.view(np.uint8).reshape(len(enc), 4 * (old_words + 1))
+    pad = np.zeros((len(enc), 4 * (new_words - old_words)), dtype=np.uint8)
+    out = np.concatenate([a[:, : 4 * old_words], pad, a[:, 4 * old_words:]],
+                         axis=1)
+    return np.ascontiguousarray(out).view(f"S{4 * (new_words + 1)}").reshape(-1)
+
+
+# ---------------------------------------------------------------------------
+# Device kernel
+# ---------------------------------------------------------------------------
+
+class RankLayout:
+    """Static layout of the fused int32 buffer (all host-computed ranks).
+
+    Segments (int32):
+      rank_b   R   #mirror entries <= read_begin   (phase 1, >=1: b"" root)
+      rank_e   R   #mirror entries <  read_end     (phase 1)
+      loA      R   #write-begins with key <= read_begin          (case A)
+      hiA      R   #write-begins with key <  read_end            (case A)
+      qb2      R   read_begin's position among sorted write endpoints
+                   (= #write endpoints sorted before read_begin's point,
+                   tag order included)                            (case B)
+      rtxn     R   owning txn of each read row
+      rsnap    R   read snapshot offset
+      perm     Wr  write row at each begin-rank (case A permutation)
+      wb2      Wr  write begin position among sorted write endpoints
+      we2      Wr  write end position among sorted write endpoints
+      wtxn     Wr  owning txn of each write row
+      w_valid  Wr  1 for real write rows
+      ub_c     M   #mirror entries <= endpoint key, per sorted endpoint
+                   (pads: n, so they merge past the live region)
+      wsrc     M   (write_row << 1) | is_begin, per sorted endpoint
+      too_old  T
+      scalars  3   [version_off, oldest_off, n]
+    """
+
+    def __init__(self, R: int, Wr: int, T: int, C: int):
+        self.R, self.Wr, self.T, self.C = R, Wr, T, C
+        self.M = 2 * Wr
+        o = 0
+        names = [
+            ("rank_b", R), ("rank_e", R), ("loA", R), ("hiA", R),
+            ("qb2", R), ("rtxn", R), ("rsnap", R),
+            ("perm", Wr), ("wb2", Wr), ("we2", Wr), ("wtxn", Wr),
+            ("w_valid", Wr),
+            ("ub_c", self.M), ("wsrc", self.M),
+            ("too_old", T), ("scalars", 3),
+        ]
+        for name, size in names:
+            setattr(self, "off_" + name, o)
+            o += size
+        self.total = o
+
+    def key(self):
+        return (self.R, self.Wr, self.T, self.C)
+
+
+def _build_table(v, op, identity, max_level: int | None = None):
+    c = v.shape[0]
+    rows = [v]
+    s = 1
+    level = 0
+    while s < c and (max_level is None or level < max_level):
+        prev = rows[-1]
+        shifted = jnp.concatenate(
+            [prev[s:], jnp.full(s, identity, dtype=v.dtype)]
+        )
+        rows.append(op(prev, shifted))
+        s *= 2
+        level += 1
+    return jnp.stack(rows)
+
+
+def _table_range_query(table, lo, hi, op, identity):
+    c = table.shape[1]
+    length = (hi - lo).astype(jnp.int32)
+    m = jnp.minimum(
+        31 - lax.clz(jnp.maximum(length, 1)), table.shape[0] - 1
+    )
+    window = jnp.left_shift(jnp.int32(1), m)
+    flat = table.reshape(-1)
+    i1 = m * c + jnp.clip(lo, 0, c - 1)
+    i2 = m * c + jnp.clip(hi - window, 0, c - 1)
+    got = flat[jnp.stack([i1, i2])]
+    return jnp.where(hi > lo, op(got[0], got[1]), identity)
+
+
+def _canonical_nodes_flat(pos_lo, pos_hi, n_leaves: int):
+    steps = n_leaves.bit_length()
+    l = (pos_lo + n_leaves).astype(jnp.int32)
+    r = (pos_hi + n_leaves).astype(jnp.int32)
+    cols = []
+    for _ in range(steps):
+        active = l < r
+        tl = active & ((l & 1) == 1)
+        cols.append(jnp.where(tl, l, 0))
+        l = l + tl
+        tr = active & ((r & 1) == 1)
+        r = r - tr
+        cols.append(jnp.where(tr, r, 0))
+        l = l >> 1
+        r = r >> 1
+    return jnp.concatenate(cols), 2 * steps
+
+
+def _rank_kernel_impl(hv, fused, *, lay: RankLayout):
+    """One resolve. hv: (C,) int32 version offsets; fused: RankLayout
+    buffer. Returns (hv_new, statuses)."""
+    R, Wr, T, C, M = lay.R, lay.Wr, lay.T, lay.C, lay.M
+    i32 = jnp.int32
+    sl = lambda name, size: lax.dynamic_slice_in_dim(
+        fused, getattr(lay, "off_" + name), size
+    )
+    rank_b = sl("rank_b", R)
+    rank_e = sl("rank_e", R)
+    loA = sl("loA", R)
+    hiA = sl("hiA", R)
+    qb2 = sl("qb2", R)
+    rtxn = sl("rtxn", R)
+    rsnap = sl("rsnap", R)
+    perm = sl("perm", Wr)
+    wb2 = sl("wb2", Wr)
+    we2 = sl("we2", Wr)
+    wtxn = sl("wtxn", Wr)
+    w_valid = sl("w_valid", Wr).astype(bool)
+    ub_c = sl("ub_c", M)
+    wsrc = sl("wsrc", M)
+    too_old = sl("too_old", T).astype(bool)
+    version = fused[lay.off_scalars]
+    oldest_eff = fused[lay.off_scalars + 1]
+    n = fused[lay.off_scalars + 2]
+
+    # ---- Phase 1: read-vs-history (range max over [rank_b-1, rank_e)) ----
+    vtab = _build_table(hv, jnp.maximum, 0)
+    hist_max = _table_range_query(vtab, rank_b - 1, rank_e, jnp.maximum, 0)
+    read_conf = (hist_max > rsnap).astype(i32)
+    hist_conf = jnp.zeros(T, dtype=i32).at[rtxn].max(read_conf)
+    base_conf = jnp.maximum(hist_conf, too_old.astype(i32))
+
+    # ---- Phase 2: intra-batch fixed point (write-endpoint space) ----
+    wnodes, n_blocks = _canonical_nodes_flat(wb2, we2, M)
+    k_levels = M.bit_length()
+    leaf = jnp.clip(qb2 - 1, 0, M - 1)
+    anc = (leaf[None, :] + M) >> jnp.arange(k_levels, dtype=i32)[:, None]
+
+    def body(carry):
+        conflict, _, it = carry
+        committed_w = w_valid & (conflict[wtxn] == 0)
+        wval = jnp.where(committed_w, wtxn, _I32_INF).astype(i32)
+        # Case A: writes whose BEGIN lies strictly inside the read span —
+        # range-min over begin-rank order [loA, hiA).
+        case_a = _table_range_query(
+            _build_table(wval[perm], jnp.minimum, _I32_INF),
+            loA, hiA, jnp.minimum, _I32_INF,
+        )
+        # Case B: writes covering the read's begin point — segment tree
+        # over the write-endpoint leaves; leaf qb2-1 (qb2 == 0 means the
+        # read point sorts before every write endpoint: nothing covers it).
+        wval_rep = jnp.broadcast_to(wval, (n_blocks, Wr)).reshape(-1)
+        tree_l = jnp.full(2 * M, _I32_INF, dtype=i32).at[wnodes].min(wval_rep)
+        stab = jnp.min(tree_l[anc], axis=0)
+        stab = jnp.where(qb2 > 0, stab, _I32_INF)
+        min_writer = jnp.minimum(case_a, stab)
+        evidence = (min_writer < rtxn).astype(i32)
+        ev_txn = jnp.zeros(T, dtype=i32).at[rtxn].max(evidence)
+        new_conflict = jnp.maximum(base_conf, ev_txn)
+        changed = jnp.any(new_conflict != conflict)
+        return new_conflict, changed, it + 1
+
+    def cond(carry):
+        _, changed, it = carry
+        return changed & (it < T + 2)
+
+    conflict, _, _ = lax.while_loop(
+        cond, body, (base_conf, jnp.array(True), jnp.int32(0))
+    )
+
+    # ---- Phase 3: superset merge (positions fully host-determined) ----
+    # Endpoint p merges at posB = p + ub_c[p]; history j at j + lbB[j]
+    # where lbB[j] = #{p: ub_c[p] <= j} (scatter-count + prefix sum).
+    committed_row = w_valid & (conflict[wtxn] == 0)
+    valid_ep = w_valid[wsrc >> 1]
+    cw_ep = committed_row[wsrc >> 1]
+    is_begin = (wsrc & 1).astype(bool)
+    pred_val = hv[jnp.clip(ub_c - 1, 0, C - 1)]
+
+    N3 = C + M
+    cnt_ub = jnp.zeros(C + 1, dtype=i32).at[jnp.minimum(ub_c, C)].add(1)
+    lbB = jnp.cumsum(cnt_ub[:C])
+    posA = jnp.arange(C, dtype=i32) + lbB
+    posB = jnp.arange(M, dtype=i32) + ub_c
+    # Coverage depth over MERGED order: +1 at committed begins, -1 at
+    # committed ends, prefix-inclusive — a slot with depth > 0 lies inside
+    # the union of committed write ranges. History entries exactly AT a
+    # range boundary can be mis-classified by the strict merged order, but
+    # a boundary endpoint always inserts an entry at the same key AFTER
+    # the history entry, and last-duplicate-wins shadows it (see module
+    # docstring).
+    delta = jnp.where(cw_ep, jnp.where(is_begin, 1, -1), 0).astype(i32)
+    depth = jnp.cumsum(jnp.zeros(N3, dtype=i32).at[posB].set(delta))
+    base = (
+        jnp.zeros(N3, dtype=i32)
+        .at[posA].set(hv)
+        .at[posB].set(jnp.where(valid_ep, pred_val, 0))
+    )
+    live_slot = (
+        jnp.zeros(N3, dtype=bool)
+        .at[posA].set(jnp.arange(C, dtype=i32) < n)
+        .at[posB].set(valid_ep)
+    )
+    merged = jnp.where(live_slot & (depth > 0), version, base)
+    # Rebase + horizon clamp (inclusive: 0 means at-or-below horizon).
+    merged = jnp.where(merged <= oldest_eff, 0, merged - oldest_eff)
+    hv_new = merged[:C]
+
+    statuses = jnp.where(
+        too_old, TOO_OLD, jnp.where(conflict[: T] > 0, CONFLICT, COMMITTED)
+    )
+    return hv_new, statuses
+
+
+_KERNEL_CACHE: dict = {}
+
+
+def _kernel_for(lay: RankLayout):
+    fn = _KERNEL_CACHE.get(lay.key())
+    if fn is None:
+        from functools import partial
+
+        fn = jax.jit(partial(_rank_kernel_impl, lay=lay),
+                     donate_argnums=(0,))
+        _KERNEL_CACHE[lay.key()] = fn
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# Host side
+# ---------------------------------------------------------------------------
+
+def _tagged(enc: np.ndarray, tag: int) -> np.ndarray:
+    """Append a tag byte so argsort orders equal keys by tag (we < wb)."""
+    w = enc.dtype.itemsize
+    a = enc.view(np.uint8).reshape(len(enc), w)
+    t = np.full((len(enc), 1), tag, dtype=np.uint8)
+    return np.ascontiguousarray(
+        np.concatenate([a, t], axis=1)
+    ).view(f"S{w + 1}").reshape(-1)
+
+
+class RankPackedBatch:
+    def __init__(self, layout, buf, base, n_txns, n_reads, n_writes,
+                 new_mirror, longest):
+        self.layout = layout
+        self.buf = buf
+        self.base = base
+        self.n_txns = n_txns
+        self.n_reads = n_reads
+        self.n_writes = n_writes
+        self.new_mirror = new_mirror  # mirror AFTER this batch's inserts
+        self.longest = longest
+
+    def set_scalars(self, version_off: int, oldest_off: int) -> None:
+        self.buf[self.layout.off_scalars] = version_off
+        self.buf[self.layout.off_scalars + 1] = oldest_off
+
+
+class PendingRankResolve:
+    def __init__(self, statuses, n_txns):
+        self._statuses = statuses
+        self.n_txns = n_txns
+
+    def result(self) -> np.ndarray:
+        return np.asarray(self._statuses)[: self.n_txns]
+
+
+class ConflictSetRankFed:
+    """ConflictSetCPU contract; device holds versions only (see module
+    docstring). Drop-in alternative to ConflictSetTPU."""
+
+    def __init__(self, init_version: int = 0, max_key_bytes: int = 32,
+                 initial_capacity: int = 1024):
+        self.n_words = max(1, (max_key_bytes + 3) // 4)
+        self.max_key_bytes = 4 * self.n_words
+        self.capacity = next_pow2(initial_capacity, minimum=64)
+        self.oldest_version = 0
+        if not (0 <= init_version < 2**31):
+            raise ValueError("init_version must fit the initial int32 window")
+        self.mirror = encode_keys([b""], self.n_words)
+        self.n = 1
+        hv = np.zeros(self.capacity, dtype=np.int32)
+        hv[0] = init_version
+        self.hv = jnp.asarray(hv)
+
+    def __len__(self) -> int:
+        return self.n
+
+    # -- introspection: canonical view, matches the oracle bit-for-bit --
+    def _canonical(self):
+        vals = np.asarray(self.hv)[: self.n]
+        enc = self.mirror
+        # Last duplicate of each key wins.
+        last = np.concatenate([enc[1:] != enc[:-1], [True]])
+        kk, vv = enc[last], vals[last]
+        # Coalesce equal adjacent values (first of each run kept).
+        keep = np.concatenate([[True], vv[1:] != vv[:-1]])
+        return kk[keep], vv[keep]
+
+    def entries(self) -> list[tuple[bytes, int]]:
+        kk, vv = self._canonical()
+        W = self.n_words
+        out = []
+        for e, v in zip(kk, vv):
+            # The encoding stores the raw key bytes zero-padded (unbiased,
+            # big-endian words == the bytes themselves) + a BE u32 length;
+            # 'S' dtype strips trailing NULs, so re-pad before slicing.
+            b = e.ljust(4 * (W + 1), b"\x00")
+            length = int.from_bytes(b[4 * W:], "big")
+            key = b[:length]
+            v = int(v)
+            out.append((key, v + self.oldest_version if v > 0 else 0))
+        return out
+
+    # -- growth --
+    def _grow(self, min_capacity: int) -> None:
+        new_cap = next_pow2(min_capacity, minimum=self.capacity * 2)
+        pad = np.zeros(new_cap - self.capacity, dtype=np.int32)
+        self.hv = jnp.concatenate([self.hv, jnp.asarray(pad)])
+        self.capacity = new_cap
+
+    def _grow_width(self, min_key_bytes: int) -> None:
+        from ..core.knobs import CLIENT_KNOBS
+
+        cap = CLIENT_KNOBS.KEY_SIZE_LIMIT + 1
+        if min_key_bytes > cap:
+            from .packing import KeyWidthError
+
+            raise KeyWidthError(
+                f"key of {min_key_bytes} bytes exceeds the deployment "
+                f"key-size limit {cap}"
+            )
+        new_words = min(
+            next_pow2((min_key_bytes + 3) // 4, minimum=self.n_words * 2),
+            next_pow2((cap + 3) // 4),
+        )
+        self.mirror = widen_encoded(self.mirror, self.n_words, new_words)
+        self.n_words = new_words
+        self.max_key_bytes = 4 * new_words
+
+    # -- GC round: re-canonicalize both sides (amortized D2H) --
+    def gc_round(self) -> None:
+        kk, vv = self._canonical()
+        self.mirror = kk
+        self.n = len(kk)
+        if self.n > (3 * self.capacity) // 4:
+            self._grow(2 * self.n)
+        hv = np.zeros(self.capacity, dtype=np.int32)
+        hv[: self.n] = vv
+        self.hv = jnp.asarray(hv)
+
+    # -- packing --
+    def pack(self, txns: Sequence[TxnConflictInfo]) -> RankPackedBatch:
+        from .packing import flatten_batch
+
+        (too_old_l, r_begin, r_end, r_txn, r_snap, w_begin, w_end, w_txn) = (
+            flatten_batch(txns, self.oldest_version)
+        )
+        nr, nw, n_txns = len(r_begin), len(w_begin), len(txns)
+        longest = 0
+        for ks in (r_begin, r_end, w_begin, w_end):
+            for k in ks:
+                if len(k) > longest:
+                    longest = len(k)
+        R = next_pow2(max(nr, 1))
+        Wr = next_pow2(max(nw, 1))
+        T = next_pow2(max(n_txns, 1))
+        lay = RankLayout(R, Wr, T, self.capacity)
+        buf = np.zeros(lay.total, dtype=np.int32)
+
+        enc_rb = encode_keys(r_begin, self.n_words)
+        enc_re = encode_keys(r_end, self.n_words)
+        enc_wb = encode_keys(w_begin, self.n_words)
+        enc_we = encode_keys(w_end, self.n_words)
+
+        # Sorted write-endpoint space (tag order: end < begin at equal key).
+        comp = np.concatenate([_tagged(enc_we, 1), _tagged(enc_wb, 2)])
+        order = np.argsort(comp, kind="stable")
+        m = 2 * nw
+        ep_enc = np.concatenate([enc_we, enc_wb])[order]
+        is_begin_sorted = (order >= nw).astype(np.int32)
+        row_sorted = np.where(order >= nw, order - nw, order).astype(np.int32)
+        inv = np.empty(m, np.int32)
+        inv[order] = np.arange(m, dtype=np.int32)
+        we2 = inv[:nw]
+        wb2 = inv[nw:]
+
+        sorted_wb = np.sort(enc_wb, kind="stable")
+        perm = np.argsort(enc_wb, kind="stable").astype(np.int32)
+
+        seg = lambda name, size: buf[
+            getattr(lay, "off_" + name):getattr(lay, "off_" + name) + size
+        ]
+        # Reads (pads inert: rank_b=1, rank_e=0, loA=hiA=0, qb2=0,
+        # rsnap=max).
+        rb_seg = seg("rank_b", R); rb_seg[:] = 1
+        re_seg = seg("rank_e", R)
+        rs_seg = seg("rsnap", R); rs_seg[:] = INT32_MAX
+        if nr:
+            rb_seg[:nr] = np.searchsorted(self.mirror, enc_rb, "right")
+            re_seg[:nr] = np.searchsorted(self.mirror, enc_re, "left")
+            seg("loA", R)[:nr] = np.searchsorted(sorted_wb, enc_rb, "right")
+            seg("hiA", R)[:nr] = np.searchsorted(sorted_wb, enc_re, "left")
+            seg("qb2", R)[:nr] = np.searchsorted(
+                np.concatenate([enc_we, enc_wb])[order], enc_rb, "right"
+            )
+            seg("rtxn", R)[:nr] = r_txn
+            rel = np.asarray(r_snap, dtype=np.int64) - self.oldest_version
+            if rel.min() < 0 or rel.max() >= 2**31:
+                raise ValueError("read snapshot outside the int32 window")
+            rs_seg[:nr] = rel.astype(np.int32)
+        # Writes (pads: perm=row index, wb2=we2=M empty interval).
+        perm_seg = seg("perm", Wr)
+        perm_seg[:] = np.arange(Wr, dtype=np.int32)
+        wb2_seg = seg("wb2", Wr); wb2_seg[:] = lay.M
+        we2_seg = seg("we2", Wr); we2_seg[:] = lay.M
+        if nw:
+            perm_seg[:nw] = perm
+            wb2_seg[:nw] = wb2
+            we2_seg[:nw] = we2
+            seg("wtxn", Wr)[:nw] = w_txn
+            seg("w_valid", Wr)[:nw] = 1
+        # Sorted endpoints (pads: ub_c=n so they merge past live region,
+        # wsrc points at a pad write row -> value 0).
+        ub_seg = seg("ub_c", lay.M); ub_seg[:] = self.n
+        ws_seg = seg("wsrc", lay.M)
+        ws_seg[:] = (Wr - 1) << 1
+        ub_real = None
+        if m:
+            ub_real = np.searchsorted(self.mirror, ep_enc, "right").astype(
+                np.int32
+            )
+            ub_seg[:m] = ub_real
+            ws_seg[:m] = (row_sorted << 1) | is_begin_sorted
+        seg("too_old", T)[:n_txns] = too_old_l
+
+        # Mirror AFTER this batch: all real endpoints inserted at their
+        # merged positions (superset; commit verdicts not needed).
+        if m:
+            new_mirror = np.empty(self.n + m, dtype=self.mirror.dtype)
+            posB = np.arange(m, dtype=np.int64) + ub_real
+            mask = np.ones(self.n + m, dtype=bool)
+            mask[posB] = False
+            new_mirror[posB] = ep_enc
+            new_mirror[mask] = self.mirror
+        else:
+            new_mirror = self.mirror
+        return RankPackedBatch(lay, buf, self.oldest_version, n_txns, nr, nw,
+                               new_mirror, longest)
+
+    # -- resolution --
+    def resolve_async(self, version: int, new_oldest_version: int,
+                      pb: RankPackedBatch) -> PendingRankResolve:
+        if pb.base != self.oldest_version:
+            raise ValueError(
+                f"batch packed at base {pb.base} but set is at "
+                f"{self.oldest_version}"
+            )
+        assert pb.layout.C == self.capacity
+        oldest_eff = max(self.oldest_version, new_oldest_version)
+        version_off = version - self.oldest_version
+        if not (0 <= version_off < 2**31):
+            raise ValueError("resolve version outside the int32 window")
+        pb.set_scalars(version_off, oldest_eff - self.oldest_version)
+        pb.buf[pb.layout.off_scalars + 2] = self.n
+        fused_dev = jax.device_put(pb.buf)
+        self.hv, statuses = _kernel_for(pb.layout)(self.hv, fused_dev)
+        self.mirror = pb.new_mirror
+        self.n = self.n + 2 * pb.n_writes
+        self.oldest_version = oldest_eff
+        return PendingRankResolve(statuses, pb.n_txns)
+
+    def resolve_packed(self, version, new_oldest_version, pb) -> np.ndarray:
+        return self.resolve_async(version, new_oldest_version, pb).result()
+
+    def resolve(
+        self, version: int, new_oldest_version: int,
+        txns: Sequence[TxnConflictInfo],
+    ) -> ConflictBatchResult:
+        # Width admission (mirrors ConflictSetTPU.resolve).
+        longest = 0
+        for t in txns:
+            if t.read_snapshot < self.oldest_version and t.read_ranges:
+                continue
+            for r in t.read_ranges:
+                if not r.is_empty():
+                    longest = max(longest, len(r.begin), len(r.end))
+            for w in t.write_ranges:
+                if not w.is_empty():
+                    longest = max(longest, len(w.begin), len(w.end))
+        if longest > self.max_key_bytes:
+            self._grow_width(longest)
+        # Capacity: superset inserts burn 2 entries per write row; GC when
+        # the pessimistic bound approaches capacity.
+        n_writes = sum(
+            1
+            for t in txns
+            if not (t.read_snapshot < self.oldest_version and t.read_ranges)
+            for w in t.write_ranges
+            if not w.is_empty()
+        )
+        if self.n + 2 * n_writes >= self.capacity - 1:
+            self.gc_round()
+            if self.n + 2 * n_writes >= self.capacity - 1:
+                self._grow(self.n + 2 * n_writes + 2)
+        pb = self.pack(txns)
+        st = self.resolve_packed(version, new_oldest_version, pb)
+        return ConflictBatchResult([int(s) for s in st])

@@ -1,0 +1,166 @@
+"""Differential tests: ConflictSetRankFed vs the CPU oracle, bit-for-bit
+(statuses AND canonicalized entries), same contract as test_conflict_tpu.
+"""
+
+import random
+
+import pytest
+
+from foundationdb_tpu.kv.keys import KeyRange, key_after
+from foundationdb_tpu.resolver import (
+    COMMITTED,
+    CONFLICT,
+    TOO_OLD,
+    ConflictSetCPU,
+    TxnConflictInfo,
+)
+from foundationdb_tpu.resolver.rankfed import ConflictSetRankFed
+
+
+def txn(snap, reads=(), writes=()):
+    return TxnConflictInfo(
+        read_snapshot=snap,
+        read_ranges=[KeyRange(b, e) for b, e in reads],
+        write_ranges=[KeyRange(b, e) for b, e in writes],
+    )
+
+
+def both():
+    return ConflictSetCPU(), ConflictSetRankFed(initial_capacity=64)
+
+
+def check(cpu, rf, version, new_oldest, txns):
+    want = cpu.resolve(version, new_oldest, txns).statuses
+    got = rf.resolve(version, new_oldest, txns).statuses
+    assert got == want, f"v={version}: rf={got} cpu={want}\ntxns={txns}"
+    assert rf.entries() == cpu.entries(), (
+        f"v={version}: entries diverge\nrf ={rf.entries()}\n"
+        f"cpu={cpu.entries()}"
+    )
+    return got
+
+
+class TestRankFedBasics:
+    def test_blind_write_then_conflicting_read(self):
+        cpu, rf = both()
+        check(cpu, rf, 10, 0, [txn(5, writes=[(b"a", b"b")])])
+        s = check(cpu, rf, 20, 0, [txn(5, reads=[(b"a", b"b")])])
+        assert s == [CONFLICT]
+        s = check(cpu, rf, 30, 0, [txn(25, reads=[(b"a", b"b")])])
+        assert s == [COMMITTED]
+
+    def test_boundary_touch(self):
+        cpu, rf = both()
+        check(cpu, rf, 10, 0, [txn(5, writes=[(b"m", b"n")])])
+        s = check(
+            cpu, rf, 20, 0,
+            [txn(5, reads=[(b"a", b"m")]), txn(5, reads=[(b"n", b"z")])],
+        )
+        assert s == [COMMITTED, COMMITTED]
+
+    def test_single_key_and_too_old(self):
+        cpu, rf = both()
+        k = b"key"
+        check(cpu, rf, 10, 0, [txn(0, writes=[(k, key_after(k))])])
+        s = check(cpu, rf, 20, 5, [txn(8, reads=[(k, key_after(k))])])
+        assert s == [CONFLICT]
+        s = check(cpu, rf, 30, 5, [txn(2, reads=[(k, key_after(k))])])
+        assert s == [TOO_OLD]
+
+    def test_intra_batch_chain(self):
+        cpu, rf = both()
+        s = check(
+            cpu, rf, 10, 0,
+            [
+                txn(5, writes=[(b"a", b"b")]),
+                txn(5, reads=[(b"a", b"b")], writes=[(b"c", b"d")]),
+                txn(5, reads=[(b"c", b"d")]),
+            ],
+        )
+        # Txn1 aborts on txn0's write; txn1's own write therefore never
+        # lands, so txn2 commits.
+        assert s == [COMMITTED, CONFLICT, COMMITTED]
+
+    def test_gc_round_preserves_semantics(self):
+        cpu, rf = both()
+        v = 10
+        for i in range(40):
+            ks = b"k%02d" % (i % 10)
+            check(cpu, rf, v, 0, [txn(v - 5, writes=[(ks, key_after(ks))])])
+            v += 10
+        rf.gc_round()
+        assert rf.entries() == cpu.entries()
+        # Still resolves identically after the round: k01's last write was
+        # at version 320, so an older snapshot conflicts and a newer one
+        # commits.
+        s = check(cpu, rf, v, 0, [txn(300, reads=[(b"k01", b"k02")])])
+        assert s == [CONFLICT]
+        s = check(cpu, rf, v + 10, 0, [txn(v, reads=[(b"k01", b"k02")])])
+        assert s == [COMMITTED]
+
+    def test_capacity_growth(self):
+        cpu, rf = both()
+        v = 10
+        for i in range(70):  # 70 * 2 entries > 64 initial capacity
+            ks = b"grow%04d" % i
+            check(cpu, rf, v, 0, [txn(v - 1, writes=[(ks, key_after(ks))])])
+            v += 1
+        assert rf.capacity > 64
+
+    def test_width_growth(self):
+        cpu, rf = both()
+        check(cpu, rf, 10, 0, [txn(5, writes=[(b"a", b"b")])])
+        long_key = b"x" * 100
+        s = check(
+            cpu, rf, 20, 0,
+            [txn(15, writes=[(long_key, key_after(long_key))])],
+        )
+        assert s == [COMMITTED]
+        s = check(
+            cpu, rf, 30, 0, [txn(15, reads=[(long_key, key_after(long_key))])]
+        )
+        assert s == [CONFLICT]
+
+
+KEYS = [bytes([c]) * ln for c in b"abcdefg" for ln in (1, 2, 3, 4)]
+
+
+def _rand_range(rng):
+    a, b = rng.choice(KEYS), rng.choice(KEYS)
+    if a == b:
+        return (a, key_after(a))
+    return (min(a, b), max(a, b))
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_differential_randomized(seed):
+    rng = random.Random(seed)
+    cpu, rf = both()
+    version = 100
+    for batch in range(10):
+        txns = []
+        for _ in range(rng.randrange(1, 15)):
+            snap = version - rng.randrange(0, 150)
+            reads = [_rand_range(rng) for _ in range(rng.randrange(0, 4))]
+            writes = [_rand_range(rng) for _ in range(rng.randrange(0, 3))]
+            txns.append(txn(snap, reads, writes))
+        new_oldest = max(0, version - 120) if rng.random() < 0.4 else 0
+        check(cpu, rf, version, new_oldest, txns)
+        version += rng.randrange(5, 60)
+
+
+def test_sliding_window_steady_state():
+    rng = random.Random(99)
+    cpu, rf = both()
+    version = 1000
+    for batch in range(30):
+        txns = []
+        for _ in range(8):
+            snap = version - rng.randrange(0, 300)
+            k = rng.choice(KEYS)
+            txns.append(
+                txn(snap, reads=[(k, key_after(k))],
+                    writes=[(rng.choice(KEYS), b"zzzz")])
+            )
+        check(cpu, rf, version, version - 400, txns)
+        version += 50
