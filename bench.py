@@ -112,6 +112,54 @@ def zipf_sampler(key_space: int, theta: float = 0.99):
 # Measurement
 # ---------------------------------------------------------------------------
 
+def time_h2d(arrays) -> float:
+    """Seconds per blocking host->device transfer, averaged over `arrays`
+    (first put is warmup and untimed)."""
+    import jax
+
+    x = jax.device_put(arrays[0])
+    jax.block_until_ready(x)
+    t0 = time.perf_counter()
+    for a in arrays:
+        x = jax.device_put(a)
+        jax.block_until_ready(x)
+    return (time.perf_counter() - t0) / len(arrays)
+
+
+def measure_env():
+    """Characterize the host<->device link so per-config numbers can be
+    attributed (on the dev pod the TPU sits behind a tunnel: ~100 ms per
+    synchronized round trip, tens of ms per transferred MB — both
+    environment floors, not kernel costs; a co-located PCIe/ICI deployment
+    has neither)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    f_tiny = jax.jit(lambda s: s * 2 + 1)
+    int(f_tiny(jnp.int32(1)))
+    t0 = time.perf_counter()
+    for r in range(5):
+        int(f_tiny(jnp.int32(r)))
+    sync_ms = (time.perf_counter() - t0) / 5 * 1e3
+
+    mb = 8
+    arrs = [
+        np.random.default_rng(i).integers(0, 100, mb << 18, dtype=np.int32)
+        for i in range(3)
+    ]
+    h2d_s_per_mb = time_h2d(arrs) / mb
+    env = {
+        "sync_roundtrip_ms": round(sync_ms, 1),
+        "h2d_ms_per_mb": round(h2d_s_per_mb * 1e3, 1),
+        "h2d_mb_per_s": round(1.0 / h2d_s_per_mb, 1),
+        "backend": jax.default_backend(),
+    }
+    log(f"[env] sync {env['sync_roundtrip_ms']} ms  "
+        f"H2D {env['h2d_mb_per_s']} MB/s")
+    return env
+
+
 def measure_tpu(batch_txns: int, n_batches: int, key_space: int, seed: int,
                 capacity: int):
     """Returns per-config dicts of steady-state throughput + latency."""
@@ -180,8 +228,19 @@ def measure_tpu(batch_txns: int, n_batches: int, key_space: int, seed: int,
             "history_entries": int(cs.n),
             "capacity": cs.capacity,
         }
+        # Stage attribution: time the H2D of real packed buffers alone, so
+        # the p50 decomposes into link floor vs device compute.
+        bufs = [pb.buf for _, pb, _ in batches[1:4]]
+        h2d_ms = time_h2d(bufs) * 1e3
+        results[name]["buffer_mb"] = round(bufs[0].nbytes / 1e6, 2)
+        results[name]["h2d_ms_per_batch"] = round(h2d_ms, 1)
+        results[name]["device_ms_est"] = round(
+            max(0.0, results[name]["p50_ms"] - h2d_ms), 1
+        )
         log(f"[{name}] {results[name]['txns_per_sec']:.0f} txns/s  "
             f"p50 {results[name]['p50_ms']:.1f} ms  "
+            f"(h2d ~{h2d_ms:.0f} ms of it, buf "
+            f"{results[name]['buffer_mb']} MB)  "
             f"conflicts {results[name]['conflict_rate']:.3f}  "
             f"entries {int(cs.n)}")
 
@@ -338,6 +397,10 @@ def main() -> None:
 
     detail: dict = {}
     value = 0.0
+    try:
+        detail["env"] = measure_env()
+    except Exception as e:  # noqa: BLE001
+        detail["env_error"] = f"{type(e).__name__}: {e}"
     try:
         res = measure_tpu(args.batch, args.batches, args.key_space,
                           args.seed, args.capacity)
