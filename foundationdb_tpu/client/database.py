@@ -17,6 +17,12 @@ T = TypeVar("T")
 class Database:
     def __init__(self, cluster, conn=None):
         self.cluster = cluster
+        # Database-level defaults inherited by every transaction (ref:
+        # DatabaseOption transaction_timeout/transaction_retry_limit).
+        from ..options import DatabaseOptions
+
+        self.options = DatabaseOptions(self)
+        self.default_transaction_options: dict = {}
         if conn is None:
             from .connection import ClusterConnection
 
@@ -26,6 +32,21 @@ class Database:
                 cluster.storage.read_stream,
             )
         self.conn = conn
+
+    def _set_option(self, code: int, value) -> None:
+        from ..options import DatabaseOptions as DO
+
+        if code in (DO.TRANSACTION_TIMEOUT, DO.TRANSACTION_RETRY_LIMIT):
+            # Database codes intentionally equal the transaction codes for
+            # these two (mirroring fdb.options), so the dict feeds
+            # Transaction._option_values directly.
+            self.default_transaction_options[code] = value
+        elif code == DO.LOCATION_CACHE_SIZE:
+            # Recorded; the sharded connection's cache is currently
+            # unbounded, so this is advisory until eviction lands.
+            self.location_cache_size = value
+        else:
+            raise ValueError(f"unknown database option code {code}")
 
     def create_transaction(self) -> Transaction:
         return Transaction(self)

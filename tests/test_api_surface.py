@@ -1,0 +1,177 @@
+"""Binding-surface tests: fdb-style api module, thread-safe facade, the
+stack tester, and IndexedSet (ref: bindings/python/fdb,
+fdbclient/ThreadSafeTransaction.actor.cpp, bindings/bindingtester,
+flow/IndexedSet.h)."""
+
+import random
+import threading
+
+import pytest
+
+import foundationdb_tpu.api as fdb
+from foundationdb_tpu.core.rand import DeterministicRandom
+from foundationdb_tpu.kv.indexed_set import IndexedSet
+from foundationdb_tpu.stack_tester import StackTester, generate_program
+
+
+# ---------------- fdb-style api ----------------
+
+def test_open_transactional_and_layers(sim):
+    async def main():
+        db = fdb.open()
+
+        @fdb.transactional
+        async def add_user(tr, uid, name):
+            tr.set(fdb.tuple.pack(("users", uid)), name)
+
+        @fdb.transactional
+        async def get_user(tr, uid):
+            return await tr.get(fdb.tuple.pack(("users", uid)))
+
+        await add_user(db, 42, b"alice")
+        assert await get_user(db, 42) == b"alice"
+
+        # Joining an existing transaction: no inner commit.
+        @fdb.transactional
+        async def both(tr):
+            await add_user(tr, 43, b"bob")
+            return await get_user(tr, 43)
+
+        assert await both(db) == b"bob"
+
+        # Directory + subspace through the same facade.
+        async def mk(tr):
+            d = await fdb.directory.create_or_open(tr, ("app",))
+            tr.set(d.pack(("x",)), b"1")
+            return d
+
+        d = await db.transact(mk)
+        assert await db.get(d.pack(("x",))) == b"1"
+        db.cluster.stop()
+
+    sim.run(main())
+
+
+def test_database_level_default_options(sim):
+    async def main():
+        db = fdb.open()
+        db.options.set_transaction_retry_limit(0)
+        tr = db.create_transaction()
+        assert tr._retries_left == 0
+        db.cluster.stop()
+
+    sim.run(main())
+
+
+# ---------------- thread-safe facade ----------------
+
+def test_threadsafe_database_cross_thread(sim):
+    from foundationdb_tpu.client.threadsafe import ThreadSafeDatabase
+    from foundationdb_tpu.core import delay
+
+    async def main():
+        db = fdb.open()
+        ts = ThreadSafeDatabase(db)
+        futs = []
+
+        def worker():
+            for i in range(5):
+                async def body(tr, i=i):
+                    tr.set(b"t%d" % i, b"v%d" % i)
+                    return i
+
+                futs.append(ts.run(body))
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        # Drive the loop until every cross-thread job resolved.
+        for _ in range(2000):
+            await delay(0.001)
+            if len(futs) == 5 and all(f.done() for f in futs):
+                break
+        assert sorted(f.result(timeout=0) for f in futs) == list(range(5))
+        for i in range(5):
+            assert await db.get(b"t%d" % i) == b"v%d" % i
+        ts.close()
+        db.cluster.stop()
+
+    sim.run(main())
+
+
+# ---------------- stack tester ----------------
+
+@pytest.mark.parametrize("seed", range(5))
+def test_stack_programs_match_model(sim, seed):
+    async def main():
+        db = fdb.open()
+        st = StackTester(db)
+        prog = generate_program(random.Random(seed), n_txns=6)
+        await st.run(prog)
+        assert await st.check(), "api diverged from the model"
+        db.cluster.stop()
+
+    sim.run(main())
+
+
+def test_stack_reset_discards(sim):
+    async def main():
+        db = fdb.open()
+        st = StackTester(db)
+        await st.run([
+            ("NEW_TRANSACTION",),
+            ("PUSH", b"st/key"), ("PUSH", b"gone"), ("SET",),
+            ("RESET",),
+            ("PUSH", b"st/key"), ("GET",), ("POP",),  # model agrees: None
+            ("COMMIT",),
+        ])
+        assert await st.check()
+        assert await db.get(b"st/key") is None
+        db.cluster.stop()
+
+    sim.run(main())
+
+
+# ---------------- IndexedSet ----------------
+
+def test_indexed_set_map_and_metrics():
+    s = IndexedSet(random=DeterministicRandom(7))
+    import random as pyrandom
+
+    rng = pyrandom.Random(3)
+    model = {}
+    for _ in range(2000):
+        k = rng.randrange(500)
+        if rng.random() < 0.3 and model:
+            s.erase(k)
+            model.pop(k, None)
+        else:
+            m = rng.randrange(1, 100)
+            s.insert(k, f"v{k}", metric=m)
+            model[k] = m
+    assert len(s) == len(model)
+    assert list(s) == [(k, f"v{k}") for k in sorted(model)]
+    # sum_range == brute force on several windows.
+    for lo, hi in [(0, 500), (10, 20), (100, 400), (499, 499)]:
+        want = sum(m for k, m in model.items() if lo <= k < hi)
+        assert s.sum_range(lo, hi) == want
+        assert s.sum_to(hi) - s.sum_to(lo) == want
+    # index_of_metric: walk the cumulative distribution.
+    total = sum(model.values())
+    keys = sorted(model)
+    acc = 0
+    for k in keys[:50]:
+        assert s.index_of_metric(acc) == k
+        acc += model[k]
+    assert s.index_of_metric(total) is None
+    assert s.index_of_metric(total - 1) == keys[-1]
+
+
+def test_indexed_set_split_point_usage():
+    """The metric query DD-style: find the key splitting total bytes in
+    half (ref: IndexedSet::index driving shard splits)."""
+    s = IndexedSet(random=DeterministicRandom(1))
+    for i in range(1000):
+        s.insert(i, None, metric=10)
+    mid = s.index_of_metric(s.sum_range(0, 1000) // 2)
+    assert 450 <= mid <= 550
