@@ -176,7 +176,7 @@ class DataDistributor:
     def __init__(self, cluster, interval: float = 0.5):
         self.cluster = cluster
         self.interval = interval
-        self.lock = MoveKeysLock()
+        self.lock = getattr(cluster, "move_keys_lock", None) or MoveKeysLock()
         self.failed: set[int] = set()  # storage tags considered failed
         self.moves_done = 0
         self.splits_done = 0

@@ -100,6 +100,11 @@ class ShardedKVCluster:
         self.excluded: set[int] = set()
         self.proxy.metadata_hook = self._apply_metadata
         self.dd = None
+        # One mover at a time across DD and test/ops tooling (ref:
+        # moveKeysLock in \xff — cluster-wide by definition).
+        from .data_distribution import MoveKeysLock
+
+        self.move_keys_lock = MoveKeysLock()
         self._started = False
 
     def start(self) -> "ShardedKVCluster":

@@ -14,7 +14,117 @@ from ..core.runtime import current_loop
 
 
 def cluster_status(cluster) -> dict[str, Any]:
+    if hasattr(cluster, "storages"):
+        return _sharded_status(cluster)
+    return _local_status(cluster)
+
+
+def _base_status(master, proxy) -> dict[str, Any]:
+    """Shared scaffolding of both tiers' status (client block, version
+    state, workload totals) — one place to evolve the schema."""
     loop = current_loop()
+    committed = proxy.txns_committed
+    conflicted = proxy.txns_conflicted + proxy.txns_too_old
+    return {
+        "client": {
+            "database_status": {"available": True},
+            "cluster_file": {"up_to_date": True},
+        },
+        "cluster": {
+            "latest_version": master.version,
+            "committed_version": master.committed.get(),
+            "recovery_state": {"name": "fully_recovered"},
+            "machine_time": loop.now(),
+            "simulated": loop.is_simulated(),
+            "workload": {
+                "transactions": {
+                    "committed": committed,
+                    "conflicted": conflicted,
+                    "started": committed + conflicted,
+                }
+            },
+        },
+    }
+
+
+def _sharded_status(cluster) -> dict[str, Any]:
+    """Status for the sharded/replicated tier: per-server storage roles,
+    per-log queues, the shard map, DD progress, and replicated config
+    (ref: the data-distribution and configuration sections of
+    mr-status.rst)."""
+    master = cluster.master
+    proxy = cluster.proxy
+    ls = cluster.log_system
+
+    roles: list[dict[str, Any]] = [
+        {
+            "role": "master",
+            "latest_version": master.version,
+            "committed_version": master.committed.get(),
+        },
+        {
+            "role": "proxy",
+            "txns_committed": proxy.txns_committed,
+            "txns_conflicted": proxy.txns_conflicted,
+            "txns_too_old": proxy.txns_too_old,
+        },
+    ]
+    for i, log in enumerate(ls.logs):
+        roles.append({
+            "role": "log",
+            "id": i,
+            "version": log.version.get(),
+            "durable_version": log.durable.get(),
+            "queue_entries": len(log._entries),
+        })
+    durable = ls.durable_version()
+    for s in cluster.storages:
+        roles.append({
+            "role": "storage",
+            "tag": s.tag,
+            "data_version": s.version.get(),
+            "keys": len(s.data),
+            "durability_lag_versions": durable - s.version.get(),
+            "excluded": s.tag in cluster.excluded,
+            "stored_bytes_estimate": int(s.metrics.byte_sample.total),
+        })
+
+    from ..kv.keys import KEYSPACE_END
+
+    shards = [
+        {"begin": b.hex(), "end": (e if e is not None else KEYSPACE_END).hex(),
+         "team": list(team)}
+        for b, e, team in cluster.shard_map.ranges()
+        if team
+    ]
+    dd = getattr(cluster, "dd", None)
+    data_distribution = {
+        "shards": len(shards),
+        "teams": [list(t) for t in sorted(cluster.shard_map.teams())],
+        "moves_done": dd.moves_done if dd else 0,
+        "splits_done": dd.splits_done if dd else 0,
+        "merges_done": dd.merges_done if dd else 0,
+        "unplaceable_servers": sorted(dd._unplaceable()) if dd else
+        sorted(cluster.excluded),
+    }
+
+    st = _base_status(master, proxy)
+    st["cluster"].update({
+        "configuration": {
+            "redundancy_mode": cluster.policy.describe(),
+            "logs": len(ls.logs),
+            "storage_servers": len(cluster.storages),
+            "values": dict(cluster.config_values),
+            "excluded_servers": sorted(cluster.excluded),
+        },
+        "data_distribution": data_distribution,
+        "shards": shards,
+        "roles": roles,
+    })
+    return st
+
+
+def _local_status(cluster) -> dict[str, Any]:
     master = cluster.master
     resolver = cluster.resolver
     proxy = cluster.proxy
@@ -61,28 +171,8 @@ def cluster_status(cluster) -> dict[str, Any]:
         },
     ]
 
-    committed = proxy.txns_committed
-    conflicted = proxy.txns_conflicted + proxy.txns_too_old
-    return {
-        "client": {
-            "database_status": {"available": True},
-            "cluster_file": {"up_to_date": True},
-        },
-        "cluster": {
-            "generation": 1,  # recovery generations arrive with the
-            # coordination tier (SURVEY §7 step 5)
-            "latest_version": master.version,
-            "committed_version": master.committed.get(),
-            "recovery_state": {"name": "fully_recovered"},
-            "machine_time": loop.now(),
-            "simulated": loop.is_simulated(),
-            "roles": roles,
-            "workload": {
-                "transactions": {
-                    "committed": committed,
-                    "conflicted": conflicted,
-                    "started": committed + conflicted,
-                }
-            },
-        },
-    }
+    st = _base_status(master, proxy)
+    st["cluster"]["generation"] = 1  # recovery generations are the
+    # RecoverableCluster tier; the one-process cluster has a single epoch
+    st["cluster"]["roles"] = roles
+    return st

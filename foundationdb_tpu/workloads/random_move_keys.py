@@ -1,0 +1,76 @@
+"""RandomMoveKeys: continuous random shard relocation during traffic
+(ref: fdbserver/workloads/RandomMoveKeys.actor.cpp — moves random key
+ranges to random teams while correctness workloads run; any lost or torn
+data surfaces in their checks)."""
+
+from __future__ import annotations
+
+from ..cluster.data_distribution import MoveKeysLock, move_keys
+from ..core.errors import ActorCancelled, OperationFailed
+from ..core.runtime import current_loop, spawn
+from ..core.trace import TraceEvent
+from ..kv.keys import KEYSPACE_END, KeyRange
+
+
+class RandomMoveKeysWorkload:
+    def __init__(self, cluster, interval: float = 0.3):
+        self.cluster = cluster
+        self.interval = interval
+        # The CLUSTER-wide lock: concurrent movers (this workload, DD
+        # healing) must serialize — move_keys has multi-phase state that
+        # two interleaved moves on overlapping ranges would corrupt (ref:
+        # the real moveKeysLock every mover takes).
+        self.lock = getattr(cluster, "move_keys_lock", None) or MoveKeysLock()
+        self.moves_done = 0
+        self._task = None
+        self._stopping = False
+
+    def start(self) -> "RandomMoveKeysWorkload":
+        self._task = spawn(self._run(), name="randomMoveKeys")
+        return self
+
+    def stop(self) -> None:
+        """Graceful: finish any in-flight move, then exit — cancelling
+        mid-move would leave union teams + unfetched destinations for the
+        closing ConsistencyCheck to trip over. Await wait_stopped() for
+        the actual exit."""
+        self._stopping = True
+
+    async def wait_stopped(self) -> None:
+        if self._task is not None:
+            await self._task.done
+
+    async def _run(self):
+        loop = current_loop()
+        c = self.cluster
+        while not self._stopping:
+            await loop.delay(self.interval * (0.5 + loop.random.random01()))
+            if self._stopping:
+                break
+            ranges = [
+                (b, e if e is not None else KEYSPACE_END, team)
+                for b, e, team in c.shard_map.ranges() if team
+            ]
+            if not ranges:
+                continue
+            b, e, old_team = ranges[loop.random.random_int(0, len(ranges))]
+            team = c.policy.select_replicas(c.replicas, random=loop.random)
+            if team is None:
+                continue
+            new_team = tuple(sorted(int(r.id) for r in team))
+            if new_team == tuple(old_team):
+                continue
+            try:
+                await move_keys(c, KeyRange(b, e), new_team, self.lock)
+                self.moves_done += 1
+            except ActorCancelled:
+                raise
+            except OperationFailed as err:
+                TraceEvent("RandomMoveKeysSkipped", severity=20).error(
+                    err
+                ).log()
+
+    async def check(self) -> bool:
+        """The workload itself has no invariant (the concurrent
+        correctness workloads carry them); success = it actually moved."""
+        return self.moves_done > 0

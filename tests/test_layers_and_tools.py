@@ -207,3 +207,37 @@ def test_cli_end_to_end():
     assert "Recovery state: fully_recovered" in out.stdout
     # After the clear, the range lists only one row.
     assert out.stdout.count("`hellp' is") == 1
+
+
+def test_sharded_cluster_status(sim):
+    from foundationdb_tpu.cluster.sharded_cluster import ShardedKVCluster
+    from foundationdb_tpu.cluster.status import cluster_status
+    from foundationdb_tpu.cluster.management import exclude_servers
+    from foundationdb_tpu.core import delay
+
+    async def main():
+        c = ShardedKVCluster(n_storage=4, n_logs=2, replication="double",
+                             shard_boundaries=[b"m"]).start()
+        db = c.database()
+        for i in range(10):
+            await db.set(b"k%d" % i, b"v")
+        await exclude_servers(db, [3])
+        await delay(0.5)
+        st = cluster_status(c)
+        cl = st["cluster"]
+        assert cl["configuration"]["storage_servers"] == 4
+        assert cl["configuration"]["excluded_servers"] == [3]
+        assert cl["data_distribution"]["shards"] == 2
+        assert len(cl["data_distribution"]["teams"]) >= 1
+        storages = [r for r in cl["roles"] if r["role"] == "storage"]
+        assert len(storages) == 4
+        assert any(r["excluded"] for r in storages)
+        logs = [r for r in cl["roles"] if r["role"] == "log"]
+        assert len(logs) == 2
+        # JSON-serializable end to end.
+        import json
+
+        json.dumps(st)
+        c.stop()
+
+    sim.run(main())

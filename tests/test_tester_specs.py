@@ -1,0 +1,70 @@
+"""Spec-driven compound tests (ref: fdbserver/tester.actor.cpp + the
+tests/fast specs: correctness workloads running WHILE churn/fault
+workloads fire, closed by a ConsistencyCheck)."""
+
+import pytest
+
+from foundationdb_tpu.workloads.tester import SpecError, run_spec
+
+
+def test_cycle_spec_local():
+    res = run_spec({
+        "seed": 11,
+        "cluster": {"kind": "local"},
+        "workloads": [{"name": "Cycle", "nodes": 16, "clients": 4,
+                       "txns": 20}],
+    })
+    assert res["ok"], res
+    assert res["Cycle"]["metrics"]["txns"] == 80
+
+
+def test_compound_spec_sharded_with_churn():
+    """The CycleTest.txt shape: Cycle + RandomMoveKeys + DD concurrently
+    on a sharded cluster, closed by ConsistencyCheck."""
+    res = run_spec({
+        "seed": 23,
+        "buggify": True,
+        "cluster": {"kind": "sharded", "n_storage": 4, "n_logs": 2,
+                    "replication": "double",
+                    "shard_boundaries": [b"cycle/\x00\x00\x00\x08"]},
+        "workloads": [
+            {"name": "Cycle", "nodes": 16, "clients": 3, "txns": 15},
+            {"name": "RandomMoveKeys", "interval": 0.4},
+            {"name": "DataDistribution", "interval": 0.3},
+        ],
+    })
+    assert res["ok"], res
+    assert res["RandomMoveKeys"]["metrics"]["moves"] >= 1
+    assert res["ConsistencyCheck"]["ok"], res["ConsistencyCheck"]
+
+
+def test_readwrite_spec_reports_metrics():
+    res = run_spec({
+        "seed": 5,
+        "cluster": {"kind": "local"},
+        "workloads": [{"name": "ReadWrite", "clients": 6, "duration": 2.0}],
+    })
+    m = res["ReadWrite"]["metrics"]
+    assert m["transactions"] > 0 and m["tps"] > 0
+    assert m["latency_p50_s"] is not None
+
+
+def test_spec_determinism():
+    spec = {
+        "seed": 7,
+        "cluster": {"kind": "sharded", "n_storage": 4, "n_logs": 2,
+                    "replication": "double", "shard_boundaries": [b"m"]},
+        "workloads": [
+            {"name": "Serializability", "clients": 3, "txns": 10},
+            {"name": "RandomMoveKeys", "interval": 0.5},
+        ],
+    }
+    a, b = run_spec(dict(spec)), run_spec(dict(spec))
+    assert a["Serializability"] == b["Serializability"]
+    assert a["RandomMoveKeys"] == b["RandomMoveKeys"]
+
+
+def test_unknown_workload_rejected():
+    with pytest.raises(SpecError):
+        run_spec({"cluster": {"kind": "local"},
+                  "workloads": [{"name": "Nope"}]})
