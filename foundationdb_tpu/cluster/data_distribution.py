@@ -91,81 +91,116 @@ async def move_keys(cluster, r: KeyRange, new_team: Sequence[int],
         for b, e, team in old_slices:
             union = tuple(sorted(set(team) | set(new_team)))
             cluster.shard_map.set_team(KeyRange(b, e), union)
-
-        # Fence version: everything at or below it will reach dests via
-        # the snapshot; everything above arrives via their tag stream.
-        # A no-op commit pushes the fence through the pipeline so the
-        # union tagging is in effect at v_f.
-        v_f = await _commit_fence(cluster)
-
-        # -- fetch: wait dests onto the stream, then snapshot each slice
-        #    at v_f from a surviving member of ITS old team --
-        for t in dests:
-            await cluster.storages[t].version.when_at_least(v_f)
-        if dests:
-            avoid = set(avoid_donors)
-            all_rows: list = []
-            for b, e, team in old_slices:
-                donors = [t for t in team if t not in avoid]
-                if not donors:
-                    from ..core.errors import OperationFailed
-
-                    # Abort the move: dests must not buffer forever, and
-                    # the map must roll back to the pre-move teams (a
-                    # lingering union team would name dests that hold
-                    # nothing and later moves could pick them as donors).
-                    for t in dests:
-                        s = cluster.storages[t]
-                        s.abort_fetch(r)
-                        s.set_assigned(r.begin, r.end, False)
-                    for ob, oe, oteam in old_slices:
-                        cluster.shard_map.set_team(KeyRange(ob, oe), oteam)
-                    raise OperationFailed(
-                        f"move_keys: no surviving donor for [{b!r}, {e!r})"
-                    )
-                donor = cluster.storages[min(donors)]
-                await donor.version.when_at_least(v_f)
-                all_rows.extend(donor.data.get_range(b, e, v_f))
+        try:
+            await _move_keys_fetch_finish(
+                cluster, r, new_team, old_slices, old_members, dests,
+                avoid_donors,
+            )
+        except BaseException:
+            # Roll the start phase back completely: destinations stop
+            # buffering and the map returns to the pre-move teams — a
+            # half-move (e.g. a recovery swallowing the fence, a dead
+            # donor) must leave the cluster exactly as found.
             for t in dests:
-                s = cluster.storages[t]
-                # Snapshot beneath, buffered stream replayed on top.
-                s.end_fetch(r, all_rows, v_f)
-                # Reads below the fence never reflect pre-fetch history
-                # on a destination (ref: the fetched shard's readable
-                # version gating in AddingShard).
-                s.oldest_version = max(s.oldest_version, v_f)
-
-        # -- finish: flip readability + the map --
-        for t in new_team:
-            cluster.storages[t].set_owned(r.begin, r.end, True)
-        for t in old_members - set(new_team):
-            s = cluster.storages[t]
-            s.set_owned(r.begin, r.end, False)
-            # Unassign FIRST: in-flight union-tagged mutations must not
-            # resurrect rows after the wipe.
-            s.set_assigned(r.begin, r.end, False)
-            s.data.clear_range(r.begin, r.end, s.version.get())
-            s.metrics.on_clear_range(r.begin, r.end)
-        cluster.shard_map.set_team(r, new_team)
-        TraceEvent("MoveKeysFinish").detail("Begin", r.begin).detail(
-            "End", r.end
-        ).detail("Version", v_f).log()
+                st = cluster.storages[t]
+                st.abort_fetch(r)
+                st.set_assigned(r.begin, r.end, False)
+            for ob, oe, oteam in old_slices:
+                cluster.shard_map.set_team(KeyRange(ob, oe), oteam)
+            raise
     finally:
         if lock is not None:
             lock.release()
 
 
+async def _move_keys_fetch_finish(cluster, r, new_team, old_slices,
+                                  old_members, dests, avoid_donors):
+    # Fence version: everything at or below it will reach dests via
+    # the snapshot; everything above arrives via their tag stream.
+    # A no-op commit pushes the fence through the pipeline so the
+    # union tagging is in effect at v_f.
+    v_f = await _commit_fence(cluster)
+
+    # -- fetch: wait dests onto the stream, then snapshot each slice
+    #    at v_f from a surviving member of ITS old team --
+    for t in dests:
+        await cluster.storages[t].version.when_at_least(v_f)
+    if dests:
+        avoid = set(avoid_donors)
+        all_rows: list = []
+        for b, e, team in old_slices:
+            donors = [t for t in team if t not in avoid]
+            if not donors:
+                from ..core.errors import OperationFailed
+
+                raise OperationFailed(
+                    f"move_keys: no surviving donor for [{b!r}, {e!r})"
+                )
+            donor = cluster.storages[min(donors)]
+            await donor.version.when_at_least(v_f)
+            all_rows.extend(donor.data.get_range(b, e, v_f))
+        for t in dests:
+            s = cluster.storages[t]
+            # Snapshot beneath, buffered stream replayed on top.
+            s.end_fetch(r, all_rows, v_f)
+            # Reads below the fence never reflect pre-fetch history
+            # on a destination (ref: the fetched shard's readable
+            # version gating in AddingShard).
+            s.oldest_version = max(s.oldest_version, v_f)
+
+    # -- finish: flip readability + the map --
+    for t in new_team:
+        cluster.storages[t].set_owned(r.begin, r.end, True)
+    for t in old_members - set(new_team):
+        s = cluster.storages[t]
+        s.set_owned(r.begin, r.end, False)
+        # Unassign FIRST: in-flight union-tagged mutations must not
+        # resurrect rows after the wipe.
+        s.set_assigned(r.begin, r.end, False)
+        s.data.clear_range(r.begin, r.end, s.version.get())
+        s.metrics.on_clear_range(r.begin, r.end)
+    cluster.shard_map.set_team(r, new_team)
+    TraceEvent("MoveKeysFinish").detail("Begin", r.begin).detail(
+        "End", r.end
+    ).detail("Version", v_f).log()
+
+
 async def _commit_fence(cluster) -> int:
-    """Drive an empty commit through the pipeline; returns its version."""
+    """Drive an empty commit through the pipeline; returns its version.
+
+    Recovery-safe: a generation change can swallow the request (dead
+    proxy, fenced log) — retry with a FRESH request against the cluster's
+    CURRENT proxy, never waiting forever (a silent hang here would wedge
+    move_keys while it holds the cluster-wide lock)."""
+    from ..core.actors import timeout
+    from ..core.errors import FdbError
+    from ..core.knobs import SERVER_KNOBS
+    from ..core.runtime import current_loop
     from .interfaces import CommitTransactionRequest
 
-    req = CommitTransactionRequest(
-        read_snapshot=0, read_conflict_ranges=(),
-        write_conflict_ranges=(), mutations=(),
-    )
-    cluster.proxy.commit_stream.send(req)
-    cid = await req.reply.future
-    return cid.version
+    loop = current_loop()
+    lost = object()
+    while True:
+        proxy = cluster.proxy
+        if proxy is None:  # mid-recovery: wait for the next generation
+            await loop.delay(0.05)
+            continue
+        req = CommitTransactionRequest(
+            read_snapshot=0, read_conflict_ranges=(),
+            write_conflict_ranges=(), mutations=(),
+        )
+        proxy.commit_stream.send(req)
+        try:
+            got = await timeout(
+                req.reply.future, SERVER_KNOBS.ROLE_RPC_TIMEOUT, lost
+            )
+        except FdbError:
+            # Fenced/recovered mid-flight: an empty commit is trivially
+            # retryable on the new generation.
+            continue
+        if got is lost:
+            continue
+        return got.version
 
 
 class DataDistributor:

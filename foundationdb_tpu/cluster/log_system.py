@@ -117,6 +117,16 @@ class TagPartitionedLogSystem:
         assert epoch >= self.locked_epoch
         self.locked_epoch = epoch
         recovery_version = min(log.lock(epoch) for log in self.logs)
+        # Quorum agreement: a commit durable on a SUBSET of logs never
+        # completed (push waits for all), so every log discards above the
+        # minimum — otherwise a tag on the durable subset would apply a
+        # mutation its teammates never see (ref: epochEnd computing the
+        # recovery version from the full quorum; the reference rolls the
+        # affected storage servers back the same way).
+        for log in self.logs:
+            log._entries = [
+                e for e in log._entries if e[0] <= recovery_version
+            ]
         TraceEvent("LogSystemLocked").detail("Epoch", epoch).detail(
             "RecoveryVersion", recovery_version
         ).log()

@@ -60,6 +60,43 @@ class EndpointRef:
         # timeout/retry machinery handles it like any lost request.
 
 
+def _bump_generation(cstate) -> int:
+    """Step 1 of every recovery: fence older generations in the
+    coordinated state (shared by both recoverable tiers)."""
+
+    def bump(cur):
+        gen = (cur or {"generation": 0})["generation"] + 1
+        return {"generation": gen, "recovery_version": None}
+
+    _, st = cstate.read_modify_write(bump)
+    return st["generation"]
+
+
+def _seal_generation(cstate, generation: int, recovery_version: int) -> None:
+    """Final step: record the generation's recovery version unless an even
+    newer generation already fenced us."""
+
+    def seal(cur):
+        if cur is None or cur["generation"] != generation:
+            return cur
+        return {"generation": generation,
+                "recovery_version": recovery_version}
+
+    cstate.read_modify_write(seal)
+
+
+def _send_recovery_txn(commit_ref, start_version: int) -> None:
+    """The recovery transaction: an empty commit driving the first version
+    of the new generation through the log so chains + GRVs converge (ref:
+    masterserver.actor.cpp:124)."""
+    from .interfaces import CommitTransactionRequest
+
+    commit_ref.send(CommitTransactionRequest(
+        read_snapshot=start_version, read_conflict_ranges=(),
+        write_conflict_ranges=(), mutations=(),
+    ))
+
+
 class RecoverableCluster:
     """A cluster whose transaction system can die and be re-recruited.
 
@@ -143,12 +180,7 @@ class RecoverableCluster:
         """Steps 1-4 of the module docstring. Synchronous: every step is
         quorum arithmetic + object construction on the loop thread."""
 
-        def bump(cur):
-            gen = (cur or {"generation": 0})["generation"] + 1
-            return {"generation": gen, "recovery_version": None}
-
-        _, st = self.cstate.read_modify_write(bump)
-        generation = st["generation"]
+        generation = _bump_generation(self.cstate)
         recovery_version = self.tlog.lock(generation)
         # The new generation's version chain must start above anything the
         # old generation ever RECEIVED at the log (purged non-durable
@@ -176,25 +208,8 @@ class RecoverableCluster:
         self.grv_ref.target = self.proxy.grv_stream
         self.commit_ref.target = self.proxy.commit_stream
 
-        # The recovery transaction (ref: masterserver.actor.cpp:124 / the
-        # recovery commit): an empty commit through the new proxy drives
-        # the first version of the new generation through the log so
-        # storage and GRVs converge even before any client acts.
-        from .interfaces import CommitTransactionRequest
-
-        rec_txn = CommitTransactionRequest(
-            read_snapshot=start_version, read_conflict_ranges=(),
-            write_conflict_ranges=(), mutations=(),
-        )
-        self.commit_ref.send(rec_txn)
-
-        def seal(cur):
-            if cur is None or cur["generation"] != generation:
-                return cur  # fenced by an even newer generation
-            return {"generation": generation,
-                    "recovery_version": recovery_version}
-
-        self.cstate.read_modify_write(seal)
+        _send_recovery_txn(self.commit_ref, start_version)
+        _seal_generation(self.cstate, generation, recovery_version)
         self.recoveries_done += 1
         TraceEvent("RecoveryComplete").detail("Generation", generation).detail(
             "RecoveryVersion", recovery_version
@@ -273,3 +288,154 @@ class RecoverableCluster:
             # silence (a wedged chain) is unhealthy.
             return True
         return got is not None
+
+
+class RecoverableShardedCluster:
+    """Recovery generations over the SHARDED tier: the tag-partitioned
+    log system and the storage fleet are long-lived; master / resolver /
+    proxy / ratekeeper are per-generation, re-recruited by the controller
+    when the commit path stops answering (ref: the same masterCore
+    sequence as RecoverableCluster, with epochEnd now fencing EVERY log —
+    TagPartitionedLogSystem::epochEnd computes the recovery version from
+    the full quorum, :107).
+
+    Composition: embeds a ShardedKVCluster for the data plane (shard map,
+    teams, DD hooks, status) and replaces its transaction system with
+    generation-scoped roles behind EndpointRefs, so clients and DD follow
+    recoveries transparently.
+    """
+
+    def __init__(self, conflict_set_factory=None, n_coordinators: int = 3,
+                 **sharded_kw):
+        from ..resolver.cpu import ConflictSetCPU
+        from .sharded_cluster import ShardedKVCluster
+
+        self.conflict_set_factory = conflict_set_factory or (
+            lambda v: ConflictSetCPU(v)
+        )
+        self.inner = ShardedKVCluster(**sharded_kw)
+        self.coordinators = [
+            CoordinatorRegister(f"coord{i}") for i in range(n_coordinators)
+        ]
+        self.cstate = CoordinatedState(self.coordinators, key="generation")
+        self.election = LeaderElection(
+            CoordinatedState(self.coordinators, key="leader"),
+            lease_seconds=1.0,
+        )
+        self.generation = 0
+        self.recoveries_done = 0
+        self.grv_ref = EndpointRef()
+        self.commit_ref = EndpointRef()
+        self.location_ref = EndpointRef()
+        self._controllers = ActorCollection()
+
+    # -- data-plane passthroughs (status/DD/tests address the cluster) --
+    def __getattr__(self, name):
+        if name == "inner":  # guard: no recursion before __init__ sets it
+            raise AttributeError(name)
+        return getattr(self.inner, name)
+
+    def start(self) -> "RecoverableShardedCluster":
+        assert not self.inner._started
+        self.inner._started = True
+        for s in self.inner.storages:
+            s.start()
+        self._recover()
+        return self
+
+    def stop(self) -> None:
+        self._controllers.cancel_all()
+        self._stop_transaction_system()
+        if self.inner.dd is not None:
+            self.inner.dd.stop()
+        for s in self.inner.storages:
+            s.stop()
+
+    def database(self):
+        from ..client.connection import ShardedConnection
+        from ..client.database import Database
+
+        conn = ShardedConnection(
+            self.grv_ref, self.commit_ref, self.location_ref,
+            {s.tag: s.read_stream for s in self.inner.storages},
+        )
+        return Database(self, conn=conn)
+
+    # -- failure injection --
+    def kill_transaction_system(self) -> None:
+        TraceEvent("TxnSystemKilled", severity=30).detail(
+            "Generation", self.generation
+        ).log()
+        self._stop_transaction_system()
+
+    def _stop_transaction_system(self) -> None:
+        inner = self.inner
+        if inner.proxy is not None:
+            inner.proxy.stop()
+        if inner.ratekeeper is not None:
+            inner.ratekeeper.stop()
+        # Null the dead generation's roles: the health probe's fast path
+        # and anything reading cluster.proxy/master must see "down", not
+        # a fenced corpse (matches RecoverableCluster's stop).
+        inner.master = None
+        inner.resolver = None
+        inner.proxy = None
+        inner.ratekeeper = None
+        self.grv_ref.target = None
+        self.commit_ref.target = None
+        self.location_ref.target = None
+
+    # -- recovery (the masterCore sequence over the log system) --
+    def _recover(self) -> None:
+        from .master import Master
+        from .proxy import CommitProxy
+        from .ratekeeper import Ratekeeper
+        from .resolver_role import ResolverRole
+
+        generation = _bump_generation(self.cstate)
+        inner = self.inner
+        recovery_version = inner.log_system.lock(generation)
+        # Storage servers whose log had a half-durable suffix (durable on
+        # a subset of logs only — that commit never completed) may have
+        # applied past the quorum recovery version: roll them back (ref:
+        # storageServerRollbackRebooter, worker.actor.cpp:346).
+        for s in inner.storages:
+            s.rollback_to(recovery_version)
+        start_version = max(
+            recovery_version,
+            max(log.version.get() for log in inner.log_system.logs),
+        )
+
+        self._stop_transaction_system()
+        self.generation = generation
+        inner.master = Master(init_version=start_version)
+        inner.resolver = ResolverRole(
+            self.conflict_set_factory(start_version),
+            init_version=start_version,
+        )
+        inner.ratekeeper = Ratekeeper(inner.log_system, inner.storages)
+        inner.ratekeeper.set_excluded(
+            inner.dd.failed if inner.dd else inner.excluded
+        )
+        inner.proxy = CommitProxy(
+            inner.master, inner.resolver, tlog=None,
+            ratekeeper=inner.ratekeeper, generation=generation,
+            log_system=inner.log_system, shard_map=inner.shard_map,
+        )
+        inner.proxy.metadata_hook = inner._apply_metadata
+        inner.ratekeeper.start()
+        inner.proxy.start()
+        self.grv_ref.target = inner.proxy.grv_stream
+        self.commit_ref.target = inner.proxy.commit_stream
+        self.location_ref.target = inner.proxy.location_stream
+
+        _send_recovery_txn(self.commit_ref, start_version)
+        _seal_generation(self.cstate, generation, recovery_version)
+        self.recoveries_done += 1
+        TraceEvent("RecoveryComplete").detail("Generation", generation).detail(
+            "RecoveryVersion", recovery_version
+        ).detail("Sharded", True).log()
+
+    # -- the controller (identical contract to RecoverableCluster's) --
+    start_controller = RecoverableCluster.start_controller
+    _txn_system_healthy = RecoverableCluster._txn_system_healthy

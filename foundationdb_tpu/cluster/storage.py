@@ -51,6 +51,9 @@ class StorageServer:
         # :77,:1761 — applying an atomic op against a half-fetched base
         # would corrupt the replica).
         self._fetches: list[tuple[KeyRange, list]] = []
+        # Bumped by rollback_to: an update batch peeked BEFORE a rollback
+        # must not keep applying after it (its entries were truncated).
+        self._rollback_epoch = 0
         # Byte-sampled metrics for DD sizing/splitting (ref:
         # StorageMetrics.actor.h; fed from the apply path like
         # byteSampleApplySet, storageserver.actor.cpp:2870).
@@ -94,9 +97,12 @@ class StorageServer:
         loop = current_loop()
         while True:
             entries = await self.tlog.peek(self.version.get())
+            epoch = self._rollback_epoch
             for version, mutations in entries:
                 if buggify("storage_slow_apply"):
                     await loop.delay(0.05 * loop.random.random01())
+                if self._rollback_epoch != epoch:
+                    break  # rolled back under us: these entries are gone
                 for m in mutations:
                     self._apply(m, version)
                 self.version.set(version)
@@ -112,6 +118,20 @@ class StorageServer:
                 self.oldest_version = new_oldest
                 self.data.forget_before(new_oldest)
             self.tlog.pop(self.version.get())
+
+    def rollback_to(self, version: int) -> None:
+        """Epoch-end rollback: discard applied state above `version` (ref:
+        storageServerRollbackRebooter, worker.actor.cpp:346 — the
+        reference reboots the role and replays its durable prefix; the
+        in-memory node trims its MVCC chains instead)."""
+        if self.version.get() <= version:
+            return
+        self._rollback_epoch += 1
+        self.data.rollback_above(version)
+        self.version.rollback_to(version)
+        TraceEvent("StorageRollback", severity=30).detail(
+            "Tag", self.tag
+        ).detail("Version", version).log()
 
     # -- shard fetch buffering (ref: AddingShard, :77) --
     def begin_fetch(self, r: KeyRange) -> None:

@@ -240,6 +240,14 @@ class NotifiedVersion:
                 still.append((at, p))
         self._waiters = still
 
+    def rollback_to(self, value: int) -> None:
+        """Move the cursor BACKWARDS — recovery-only (ref: the storage
+        rollback path, storageserver.actor.cpp rollback + rebooter).
+        Waiters above the new value keep waiting: their versions will be
+        reached again by the new generation's chain."""
+        assert value <= self._value
+        self._value = value
+
     def when_at_least(self, at: int) -> Future:
         if self._value >= at:
             return ready_future(None)

@@ -115,6 +115,22 @@ class VersionedMap:
                     break
         return out
 
+    def rollback_above(self, version: int) -> None:
+        """Discard every write with version > `version` (ref: the storage
+        rollback after an epoch end — mutations above the recovery version
+        never happened). O(keys) — recovery path, not the hot path."""
+        dead: list[bytes] = []
+        for key, c in self._chains.items():
+            while c and c[-1][0] > version:
+                c.pop()
+            if not c:
+                dead.append(key)
+        for key in dead:
+            del self._chains[key]
+            i = bisect_left(self._keys, key)
+            del self._keys[i]
+        self.latest_version = min(self.latest_version, version)
+
     # -- window maintenance (ref: storageserver MVCC window + PTree
     #    forgetVersionsBefore) --
     def forget_before(self, version: int) -> None:
