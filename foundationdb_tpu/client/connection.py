@@ -113,7 +113,9 @@ class ShardedConnection(ClusterConnection):
         super().__init__(grv_endpoint, commit_endpoint,
                          storage_endpoint=None)
         self.location_endpoint = location_endpoint
-        self.storage_endpoints = dict(storage_endpoints)
+        # Kept by REFERENCE: discovery (monitor_leader) updates the same
+        # mapping in place when a recovery republishes endpoints.
+        self.storage_endpoints = storage_endpoints
         self.failure_monitor = failure_monitor
         self.failure_names = failure_names or {}
         from ..kv.keyrange_map import KeyRangeMap

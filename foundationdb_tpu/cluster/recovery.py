@@ -431,6 +431,18 @@ class RecoverableShardedCluster:
 
         _send_recovery_txn(self.commit_ref, start_version)
         _seal_generation(self.cstate, generation, recovery_version)
+        # Advertise the generation's endpoints through the coordinators so
+        # discovery-based clients (monitor_leader.connect) follow without
+        # any shared refs (ref: the leader interface MonitorLeader polls).
+        from .monitor_leader import publish_interface
+
+        publish_interface(self.coordinators, {
+            "generation": generation,
+            "grv": inner.proxy.grv_stream,
+            "commit": inner.proxy.commit_stream,
+            "location": inner.proxy.location_stream,
+            "storage": {s.tag: s.read_stream for s in inner.storages},
+        })
         self.recoveries_done += 1
         TraceEvent("RecoveryComplete").detail("Generation", generation).detail(
             "RecoveryVersion", recovery_version
