@@ -48,6 +48,27 @@ def _read_recs(f):
         yield key, value
 
 
+async def _write_snapshot(out, tr, version: int, begin: bytes, end: bytes,
+                          chunk_rows: int) -> int:
+    """ONE implementation of the snapshot wire format (header + records),
+    shared by the file and container paths; returns rows written."""
+    from .kv.keys import key_after
+
+    out.write(MAGIC + struct.pack("<q", version))
+    rows = 0
+    cursor = begin
+    while True:
+        chunk = await tr.get_range(cursor, end, limit=chunk_rows,
+                                   snapshot=True)
+        for k, v in chunk:
+            _write_rec(out, k, v)
+            rows += 1
+        if len(chunk) < chunk_rows:
+            break
+        cursor = key_after(chunk[-1][0])
+    return rows
+
+
 async def backup(
     db: Database,
     path: str,
@@ -56,26 +77,14 @@ async def backup(
     chunk_rows: int = 1000,
 ) -> int:
     """Snapshot [begin, end) to `path`; returns the snapshot version."""
-    from .kv.keys import key_after
-
     tr = db.create_transaction()
     version = await tr.get_read_version()
     rows = 0
     tmp = path + ".part"
     try:
         with open(tmp, "wb") as f:
-            f.write(MAGIC + struct.pack("<q", version))
-            cursor = begin
-            while True:
-                chunk = await tr.get_range(
-                    cursor, end, limit=chunk_rows, snapshot=True
-                )
-                for k, v in chunk:
-                    _write_rec(f, k, v)
-                    rows += 1
-                if len(chunk) < chunk_rows:
-                    break
-                cursor = key_after(chunk[-1][0])
+            rows = await _write_snapshot(f, tr, version, begin, end,
+                                         chunk_rows)
             f.flush()
             os.fsync(f.fileno())
     except BaseException:
@@ -149,3 +158,56 @@ async def restore(
         "Rows", total
     ).log()
     return total
+
+
+# -- container-addressed backups (ref: BackupContainer.actor.cpp URLs) --
+
+async def backup_to_container(db: Database, url: str, begin: bytes = b"",
+                              end: bytes = b"\xff",
+                              chunk_rows: int = 1000) -> int:
+    """Snapshot into a container (file:// dir, memory:// store): the
+    snapshot file lands under snapshots/ named by its version, so the
+    container accumulates a restorable history (ref: the reference's
+    snapshot sets + describeBackup)."""
+    import io
+
+    from .backup_container import open_container
+
+    container = open_container(url)
+    tr = db.create_transaction()
+    version = await tr.get_read_version()
+    buf = io.BytesIO()
+    rows = await _write_snapshot(buf, tr, version, begin, end, chunk_rows)
+    container.write_file(container.snapshot_name(version), buf.getvalue())
+    TraceEvent("BackupComplete").detail("Container", url).detail(
+        "Version", version
+    ).detail("Rows", rows).log()
+    return version
+
+
+async def restore_from_container(db: Database, url: str,
+                                 version: int | None = None,
+                                 begin: bytes = b"",
+                                 end: bytes = b"\xff") -> int:
+    """Restore the container's snapshot at `version` (default: latest
+    restorable) into [begin, end); returns rows restored."""
+    import io
+    import tempfile
+
+    from .backup_container import open_container
+
+    container = open_container(url)
+    if version is None:
+        version = container.latest_restorable_version()
+        if version is None:
+            raise ValueError(f"container {url} holds no snapshots")
+    data = container.read_file(container.snapshot_name(version))
+    # Reuse the file-based restore: materialize to a temp file (restore
+    # streams records and owns the marker protocol).
+    with tempfile.NamedTemporaryFile(suffix=".fdbsnap", delete=False) as f:
+        f.write(data)
+        tmp = f.name
+    try:
+        return await restore(db, tmp, begin, end)
+    finally:
+        os.unlink(tmp)

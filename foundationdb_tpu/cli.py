@@ -13,6 +13,9 @@ Commands (the fdbcli core surface):
     clearrange <begin> <end>      clear a range
     getrange <begin> <end> [lim]  list key/value pairs
     status [json]                 cluster status (summary or full JSON)
+    backup <url>                  snapshot into a container (fdbbackup)
+    restore <url> [version]       restore a container snapshot (fdbrestore)
+    backups <url>                 list a container's snapshot versions
     writemode <on|off>            guard mutations like fdbcli does
     help / exit
 """
@@ -26,6 +29,12 @@ from .client.database import Database
 from .cluster import LocalCluster
 from .cluster.status import cluster_status
 from .core.runtime import EventLoop, loop_context
+
+
+def _backup_mod():
+    from . import backup as _backup
+
+    return _backup
 
 
 def _b(token: str) -> bytes:
@@ -115,6 +124,25 @@ class Cli:
                 f"Roles:          "
                 + ", ".join(r["role"] for r in c["roles"])
             )
+        if cmd == "backup":
+            if len(args) != 1:
+                return "usage: backup <container-url>  (file://dir | memory://name)"
+            v = self._run(_backup_mod().backup_to_container(self.db, args[0]))
+            return f"backup complete at version {v}"
+        if cmd == "restore":
+            self._need_write_mode()
+            if not 1 <= len(args) <= 2:
+                return "usage: restore <container-url> [version]"
+            ver = int(args[1]) if len(args) == 2 else None
+            n = self._run(_backup_mod().restore_from_container(
+                self.db, args[0], ver))
+            return f"restored {n} rows"
+        if cmd == "backups":
+            if len(args) != 1:
+                return "usage: backups <container-url>"
+            from .backup_container import open_container
+            snaps = open_container(args[0]).list_snapshots()
+            return "\n".join(str(s) for s in snaps) or "(none)"
         if cmd == "writemode":
             self.write_mode = args and args[0] == "on"
             return f"writemode {'on' if self.write_mode else 'off'}"

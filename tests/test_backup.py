@@ -81,3 +81,56 @@ def test_backup_is_consistent_under_concurrent_writes(tmp_path):
 
         rows = loop.run(main(), timeout_sim_seconds=1e6)
     assert rows[b"pair/a"] == rows[b"pair/b"], "torn snapshot"
+
+
+def test_backup_containers_roundtrip(tmp_path, sim):
+    """Container-addressed backups: file:// and memory:// accumulate a
+    restorable snapshot history (ref: BackupContainer.actor.cpp)."""
+    import pytest as _pytest
+
+    from foundationdb_tpu.backup import (
+        backup_to_container,
+        restore_from_container,
+    )
+    from foundationdb_tpu.backup_container import (
+        open_container,
+        parse_blobstore_url,
+    )
+    from foundationdb_tpu.cluster.cluster import LocalCluster
+
+    async def main():
+        c = LocalCluster().start()
+        db = c.database()
+        url = f"file://{tmp_path}/bk"
+        await db.set(b"a", b"1")
+        v1 = await backup_to_container(db, url)
+        await db.set(b"a", b"2")
+        await db.set(b"b", b"3")
+        v2 = await backup_to_container(db, url)
+        assert open_container(url).list_snapshots() == [v1, v2]
+
+        # Restore latest into a fresh cluster.
+        c2 = LocalCluster().start()
+        db2 = c2.database()
+        await restore_from_container(db2, url)
+        assert await db2.get(b"a") == b"2" and await db2.get(b"b") == b"3"
+        # Restore the OLDER snapshot by version (point-in-time choice).
+        await restore_from_container(db2, url, version=v1)
+        assert await db2.get(b"a") == b"1" and await db2.get(b"b") is None
+
+        # memory:// exercises the same code paths containerlessly.
+        murl = "memory://t1"
+        await backup_to_container(db, murl)
+        c3 = LocalCluster().start()
+        db3 = c3.database()
+        await restore_from_container(db3, murl)
+        assert await db3.get(b"a") == b"2"
+
+        # blobstore URLs parse (format check) but are gated: no egress.
+        p = parse_blobstore_url("blobstore://k:s@host:443/bucket")
+        assert p["bucket"] == "bucket"
+        with _pytest.raises(ValueError):
+            open_container("blobstore://k:s@host:443/bucket")
+        c.stop(); c2.stop(); c3.stop()
+
+    sim.run(main())
