@@ -1,0 +1,99 @@
+"""MetricLogger: time-series metrics persisted INTO the database itself
+(ref: flow/TDMetric.actor.h + fdbclient/MetricLogger.actor.cpp — the
+reference writes counter samples under a system-key subspace so operators
+can query the cluster's history from the cluster).
+
+Layout (tuple-encoded under \\xff/metrics/):
+
+    ("m", collection_id, counter_name, time_bucket) -> (total, rate)
+
+One logger actor samples registered CounterCollections on an interval and
+writes each counter's cumulative total + windowed rate; `read_series`
+returns the stored series for dashboards/tests."""
+
+from __future__ import annotations
+
+import struct
+from typing import Optional
+
+from ..core.errors import ActorCancelled
+from ..core.runtime import Task, current_loop, spawn
+from ..core.stats import CounterCollection
+from ..layers import tuple as tuplelayer
+
+METRICS_PREFIX = b"\xff/metrics/"
+
+
+def _key(collection: str, counter: str, bucket: int) -> bytes:
+    return METRICS_PREFIX + tuplelayer.pack((collection, counter, bucket))
+
+
+def _value(total: int, rate: float) -> bytes:
+    return struct.pack("<qd", total, rate)
+
+
+class MetricLogger:
+    def __init__(self, db, interval: float = 1.0):
+        self.db = db
+        self.interval = interval
+        self._collections: list[CounterCollection] = []
+        self._last: dict[tuple[str, str], int] = {}
+        self._task: Optional[Task] = None
+
+    def register(self, collection: CounterCollection) -> None:
+        self._collections.append(collection)
+
+    def start(self) -> "MetricLogger":
+        self._task = spawn(self._run(), name="metricLogger")
+        return self
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+
+    async def _run(self):
+        loop = current_loop()
+        while True:
+            await loop.delay(self.interval)
+            bucket = int(loop.now() / self.interval)
+            samples = []
+            for coll in self._collections:
+                for c in coll.counters:
+                    prev = self._last.get((coll.name, c.name), 0)
+                    rate = (c.total - prev) / self.interval
+                    self._last[(coll.name, c.name)] = c.total
+                    samples.append((coll.name, c.name, bucket, c.total, rate))
+            if not samples:
+                continue
+
+            async def body(tr, samples=samples):
+                tr.options.set_access_system_keys()
+                for coll_name, cname, b, total, rate in samples:
+                    tr.set(_key(coll_name, cname, b), _value(total, rate))
+
+            try:
+                await self.db.transact(body)
+            except ActorCancelled:
+                raise  # stop() must be prompt, not diverted
+            except Exception:  # noqa: BLE001 — metrics are best-effort
+                pass
+
+
+async def read_series(db, collection: str, counter: str,
+                      limit: int = 0) -> list[tuple[int, int, float]]:
+    """[(time_bucket, total, rate)] for one counter, oldest first (ref:
+    the TDMetric read path MetricLogger's consumers use)."""
+    b = METRICS_PREFIX + tuplelayer.pack((collection, counter))
+    e = b + b"\xff"
+
+    async def body(tr):
+        tr.options.set_read_system_keys()
+        return await tr.get_range(b, e, limit=limit)
+
+    rows = await db.transact(body)
+    out = []
+    for k, v in rows:
+        bucket = tuplelayer.unpack(k[len(METRICS_PREFIX):])[-1]
+        total, rate = struct.unpack("<qd", v)
+        out.append((bucket, total, rate))
+    return out

@@ -241,3 +241,34 @@ def test_sharded_cluster_status(sim):
         c.stop()
 
     sim.run(main())
+
+
+def test_metric_logger_time_series_in_db(sim):
+    """Counters sampled INTO the database itself (ref: TDMetric +
+    MetricLogger — the cluster stores its own metrics history)."""
+    from foundationdb_tpu.cluster.cluster import LocalCluster
+    from foundationdb_tpu.cluster.metric_logger import MetricLogger, read_series
+    from foundationdb_tpu.core import delay
+
+    async def main():
+        c = LocalCluster().start()
+        db = c.database()
+        ml = MetricLogger(db, interval=0.5)
+        ml.register(c.proxy.stats)
+        ml.start()
+        # Generate commits so TxnsCommitted moves between samples.
+        for i in range(10):
+            await db.set(b"k%d" % i, b"v")
+            await delay(0.2)
+        await delay(1.0)
+        series = await read_series(db, "ProxyStats", "TxnsCommitted")
+        assert len(series) >= 3
+        buckets = [s[0] for s in series]
+        totals = [s[1] for s in series]
+        assert buckets == sorted(buckets)
+        assert totals == sorted(totals) and totals[-1] >= 10
+        assert any(rate > 0 for _, _, rate in series)
+        ml.stop()
+        c.stop()
+
+    sim.run(main())
