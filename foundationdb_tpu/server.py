@@ -1,0 +1,121 @@
+"""The server entrypoint (ref: fdbserver/fdbserver.actor.cpp — one binary
+hosting every role, selected by `-r`: fdbd, simulation, test, ...; knobs
+set via --knob_NAME).
+
+    python -m foundationdb_tpu.server -r simulation -f spec.json
+    python -m foundationdb_tpu.server -r fdbd [--sharded ...]
+    python -m foundationdb_tpu.server -r cli
+
+Roles:
+  simulation   run a spec file (the workloads/tester format, JSON) under
+               the deterministic simulator and print the result JSON —
+               exit 0 iff every workload checked out (ref: -r simulation
+               -f tests/fast/CycleTest.txt).
+  fdbd         start an in-process cluster on a real-clock loop and serve
+               until SIGINT (the embedded stand-in for a networked fdbd;
+               combine with native/fdbtpu_monitor for supervision).
+  cli          the interactive operator shell (= foundationdb_tpu.cli).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _apply_knobs(knob_args: list[str]) -> None:
+    from .core.knobs import CLIENT_KNOBS, SERVER_KNOBS
+
+    for ka in knob_args:
+        name, _, value = ka.partition("=")
+        if not value:
+            raise SystemExit(f"--knob {ka!r}: expected NAME=VALUE")
+        name = name.upper()
+        for knobs in (SERVER_KNOBS, CLIENT_KNOBS):
+            try:
+                knobs.set_knob(name, value)
+                break
+            except KeyError:
+                continue
+        else:
+            raise SystemExit(f"unknown knob {name}")
+
+
+def _spec_from_file(path: str) -> dict:
+    with open(path) as f:
+        spec = json.load(f)
+    # Byte-ish fields arrive as strings in JSON; shard boundaries are the
+    # only ones the spec format needs.
+    ckw = spec.get("cluster", {})
+    if "shard_boundaries" in ckw:
+        ckw["shard_boundaries"] = [
+            b.encode() if isinstance(b, str) else b
+            for b in ckw["shard_boundaries"]
+        ]
+    return spec
+
+
+def run_simulation(path: str) -> int:
+    from .workloads.tester import run_spec
+
+    result = run_spec(_spec_from_file(path))
+    print(json.dumps(result, default=str, indent=2))
+    return 0 if result.get("ok") and result.get("sev_errors", 0) == 0 else 1
+
+
+def run_fdbd(sharded: bool) -> int:
+    from .core.runtime import EventLoop, loop_context
+
+    loop = EventLoop()
+    with loop_context(loop):
+        if sharded:
+            from .cluster.sharded_cluster import ShardedKVCluster
+
+            cluster = ShardedKVCluster().start()
+        else:
+            from .cluster.cluster import LocalCluster
+
+            cluster = LocalCluster().start()
+        print("fdbtpu: cluster serving (ctrl-c to stop)", file=sys.stderr)
+
+        async def serve_forever():
+            from .core.runtime import current_loop
+
+            while True:
+                await current_loop().delay(3600.0)
+
+        try:
+            loop.run(serve_forever())
+        except KeyboardInterrupt:
+            cluster.stop()
+            print("fdbtpu: shutdown", file=sys.stderr)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="foundationdb_tpu.server")
+    ap.add_argument("-r", "--role", default="fdbd",
+                    choices=["fdbd", "simulation", "cli"])
+    ap.add_argument("-f", "--testfile", help="spec file for -r simulation")
+    ap.add_argument("--sharded", action="store_true",
+                    help="fdbd: start the sharded/replicated tier")
+    ap.add_argument("--knob", action="append", default=[],
+                    metavar="NAME=VALUE", help="set a knob (repeatable)")
+    args = ap.parse_args(argv)
+    _apply_knobs(args.knob)
+
+    if args.role == "simulation":
+        if not args.testfile:
+            ap.error("-r simulation requires -f <spec.json>")
+        return run_simulation(args.testfile)
+    if args.role == "cli":
+        from .cli import main as cli_main
+
+        cli_main()
+        return 0
+    return run_fdbd(args.sharded)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,68 @@
+"""Server entrypoint tests (ref: fdbserver/fdbserver.actor.cpp role
+dispatch + --knob handling)."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+
+def _run(*args, timeout=120):
+    return subprocess.run(
+        [sys.executable, "-m", "foundationdb_tpu.server", *args],
+        capture_output=True, text=True, timeout=timeout,
+    )
+
+
+def test_simulation_role_runs_spec_and_exits_zero(tmp_path):
+    spec = {
+        "seed": 4,
+        "cluster": {"kind": "local"},
+        "workloads": [{"name": "Cycle", "nodes": 12, "clients": 3,
+                       "txns": 10}],
+    }
+    f = tmp_path / "spec.json"
+    f.write_text(json.dumps(spec))
+    r = _run("-r", "simulation", "-f", str(f))
+    assert r.returncode == 0, r.stdout + r.stderr
+    out = json.loads(r.stdout)
+    assert out["ok"] and out["Cycle"]["metrics"]["txns"] == 30
+
+
+def test_simulation_role_sharded_spec_with_boundaries(tmp_path):
+    spec = {
+        "seed": 9,
+        "cluster": {"kind": "sharded", "n_storage": 4, "n_logs": 2,
+                    "replication": "double", "shard_boundaries": ["m"]},
+        "workloads": [{"name": "Serializability", "clients": 3,
+                       "txns": 8}],
+    }
+    f = tmp_path / "spec.json"
+    f.write_text(json.dumps(spec))
+    r = _run("-r", "simulation", "-f", str(f))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert json.loads(r.stdout)["ConsistencyCheck"]["ok"]
+
+
+def test_knob_flag_applies(tmp_path):
+    spec = {"seed": 1, "cluster": {"kind": "local"},
+            "workloads": [{"name": "ReadWrite", "clients": 2,
+                           "duration": 0.5}]}
+    f = tmp_path / "spec.json"
+    f.write_text(json.dumps(spec))
+    r = _run("-r", "simulation", "-f", str(f),
+             "--knob", "grv_batch_interval=0.002")
+    assert r.returncode == 0, r.stderr
+    r2 = _run("-r", "simulation", "-f", str(f), "--knob", "nope=1")
+    assert r2.returncode != 0
+    assert "unknown knob" in r2.stderr
+
+
+def test_checked_in_specs_pass():
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = _run("-r", "simulation", "-f",
+             os.path.join(root, "specs", "readwrite_local.json"))
+    assert r.returncode == 0, r.stdout + r.stderr
