@@ -38,6 +38,10 @@ def _apply_knobs(knob_args: list[str]) -> None:
                 break
             except KeyError:
                 continue
+            except (TypeError, ValueError) as e:
+                raise SystemExit(
+                    f"bad value for knob {name}: {value!r} ({e})"
+                )
         else:
             raise SystemExit(f"unknown knob {name}")
 

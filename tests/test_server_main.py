@@ -59,10 +59,12 @@ def test_knob_flag_applies(tmp_path):
     assert "unknown knob" in r2.stderr
 
 
-def test_checked_in_specs_pass():
+@pytest.mark.parametrize(
+    "spec", ["readwrite_local.json", "cycle_churn.json"]
+)
+def test_checked_in_specs_pass(spec):
     import os
 
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    r = _run("-r", "simulation", "-f",
-             os.path.join(root, "specs", "readwrite_local.json"))
+    r = _run("-r", "simulation", "-f", os.path.join(root, "specs", spec))
     assert r.returncode == 0, r.stdout + r.stderr
