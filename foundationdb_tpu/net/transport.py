@@ -393,27 +393,39 @@ class FlowTransport:
             # Unknown endpoint: reply with an error so callers fail fast
             # (the reference drops these; failing fast aids debugging).
             if reply_token:
-                self._send_reply(src_addr, reply_token,
+                self._send_reply(conn, src_addr, reply_token,
                                  ConnectionFailed("unknown endpoint"), True)
             return
         if reply_token:
             req.reply = Promise()
             req.reply.future.add_callback(
                 lambda f: self._send_reply(
-                    src_addr, reply_token,
+                    conn, src_addr, reply_token,
                     f._value, f.is_error(),
                 )
             )
         stream.send(req)
 
-    def _send_reply(self, addr: str, reply_token: int, value, is_error: bool) -> None:
+    def _send_reply(self, conn: _Connection, addr: str, reply_token: int,
+                    value, is_error: bool) -> None:
         w = BinaryWriter()
         w.u8(1)
         w.u64(reply_token).u8(1 if is_error else 0)
         if is_error and not isinstance(value, BaseException):
             value = ConnectionFailed(str(value))
         encode_value(w, value)
-        self._peer(addr).send(w.to_bytes())
+        # Reply on the ORIGINATING connection when it is still up (the
+        # reference answers on the same TCP stream; it also lets
+        # listener-less clients — the C wire client — receive replies),
+        # falling back to a dialed peer connection only if it died.
+        if conn is not None and not conn._closed:
+            conn.send_frame(w.to_bytes())
+        elif addr and not addr.startswith("0.0.0.0:"):
+            self._peer(addr).send(w.to_bytes())
+        # else: the source never advertised a real listen address
+        # (listener-less wire client) and its connection is gone — the
+        # reply has nowhere to go; reliable-until-connection-loss says
+        # drop it.
 
     def _dispatch_reply(self, r: BinaryReader) -> None:
         reply_token, is_err = r.u64(), r.u8()

@@ -1,0 +1,356 @@
+// fdb_c-style C client speaking the framework's wire protocol (ref:
+// bindings/c/fdb_c.cpp — the C ABI every other binding wraps; here the
+// client talks the REAL network protocol of foundationdb_tpu/net:
+// crc32c-framed packets, the FDBTPU connect handshake, tagged value
+// encoding, request/reply tokens — fdbrpc/FlowTransport.actor.cpp's
+// contract, implemented natively with no Python in the loop).
+//
+// Scope: the core data-plane ops against a served cluster
+// (net/service.py well-known tokens): get read version, point get,
+// and single/multi-mutation commits. Synchronous API (one outstanding
+// request per handle), matching the blocking fdb_c usage pattern.
+//
+//   void* h = fdbc_connect("127.0.0.1", port);
+//   int64_t rv = fdbc_get_read_version(h);
+//   fdbc_tr_set(h, k, klen, v, vlen);          // buffer mutations
+//   int64_t cv = fdbc_commit(h, rv);           // commit at snapshot rv
+//   int st = fdbc_get(h, k, klen, rv2, &val, &vlen);
+//   fdbc_destroy(h);
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kProtocolVersion = 0x0FDB700001ULL;
+constexpr uint64_t kTokenGRV = 10, kTokenCommit = 11, kTokenRead = 12;
+
+// value codec tags (core/serialize.py)
+enum Tag : uint8_t {
+  T_NONE = 0, T_TRUE = 1, T_FALSE = 2, T_INT = 3, T_BIGINT = 4,
+  T_FLOAT = 5, T_BYTES = 6, T_STR = 7, T_LIST = 8, T_TUPLE = 9,
+  T_DICT = 10, T_ENUM = 11, T_OBJ = 12, T_ERROR = 13,
+};
+
+uint32_t crc_table[256];
+struct CrcInit {
+  CrcInit() {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++) c = (c & 1) ? 0x82F63B78u ^ (c >> 1) : c >> 1;
+      crc_table[i] = c;
+    }
+  }
+} crc_init;
+
+uint32_t crc32c(const uint8_t* d, size_t n) {
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; i++) c = crc_table[(c ^ d[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+struct Buf {
+  std::string s;
+  void u8(uint8_t v) { s.push_back((char)v); }
+  void u32(uint32_t v) { s.append((const char*)&v, 4); }
+  void i64(int64_t v) { s.append((const char*)&v, 8); }
+  void u64(uint64_t v) { s.append((const char*)&v, 8); }
+  void bytes(const uint8_t* p, uint32_t n) { u32(n); s.append((const char*)p, n); }
+  void str(const std::string& v) { u32((uint32_t)v.size()); s += v; }
+  // value-codec helpers
+  void v_int(int64_t v) { u8(T_INT); i64(v); }
+  void v_bytes(const uint8_t* p, uint32_t n) { u8(T_BYTES); bytes(p, n); }
+  void v_str(const std::string& v) { u8(T_STR); str(v); }
+  void v_enum(const std::string& cls, int64_t v) { u8(T_ENUM); str(cls); i64(v); }
+};
+
+struct Reader {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool fail = false;
+  uint8_t u8() { if (p + 1 > end) { fail = true; return 0; } return *p++; }
+  uint32_t u32() { if (p + 4 > end) { fail = true; return 0; } uint32_t v; memcpy(&v, p, 4); p += 4; return v; }
+  int64_t i64() { if (p + 8 > end) { fail = true; return 0; } int64_t v; memcpy(&v, p, 8); p += 8; return v; }
+  uint64_t u64() { if (p + 8 > end) { fail = true; return 0; } uint64_t v; memcpy(&v, p, 8); p += 8; return v; }
+  std::string bytes() {
+    uint32_t n = u32();
+    if (fail || p + n > end) { fail = true; return ""; }
+    std::string out((const char*)p, n); p += n; return out;
+  }
+};
+
+struct Mutation {
+  int type;  // 0 = SET_VALUE, 1 = CLEAR_RANGE, others = atomics
+  std::string p1, p2;
+};
+
+struct Handle {
+  int fd = -1;
+  bool sent_connect = false;
+  uint64_t next_reply = 1;
+  std::string rbuf;
+  std::vector<Mutation> pending;
+  int last_error = 0;          // FdbError code of the last failed op
+  std::string last_value;      // storage for fdbc_get results
+};
+
+bool send_all(Handle* h, const std::string& data) {
+  size_t off = 0;
+  while (off < data.size()) {
+    ssize_t n = send(h->fd, data.data() + off, data.size() - off, 0);
+    if (n <= 0) return false;
+    off += (size_t)n;
+  }
+  return true;
+}
+
+bool send_frame(Handle* h, const std::string& payload) {
+  if (!h->sent_connect) {
+    h->sent_connect = true;
+    Buf cp;
+    cp.s.append("FDBTPU\x00\x01", 8);
+    cp.u64(kProtocolVersion);
+    cp.str("0.0.0.0:0");  // listener-less: replies ride this connection
+    Buf f;
+    f.u32((uint32_t)cp.s.size());
+    f.u32(crc32c((const uint8_t*)cp.s.data(), cp.s.size()));
+    f.s += cp.s;
+    if (!send_all(h, f.s)) return false;
+  }
+  Buf f;
+  f.u32((uint32_t)payload.size());
+  f.u32(crc32c((const uint8_t*)payload.data(), payload.size()));
+  f.s += payload;
+  return send_all(h, f.s);
+}
+
+// Read frames until the reply with `reply_token` arrives; returns the
+// payload AFTER the (kind, token, is_err) header, setting *is_err.
+bool recv_reply(Handle* h, uint64_t reply_token, std::string& value_out,
+                bool* is_err) {
+  for (;;) {
+    // Fill until one whole frame is available.
+    while (h->rbuf.size() < 8 ||
+           h->rbuf.size() < 8 + *(uint32_t*)h->rbuf.data()) {
+      char tmp[1 << 16];
+      ssize_t n = recv(h->fd, tmp, sizeof tmp, 0);
+      if (n <= 0) return false;
+      h->rbuf.append(tmp, (size_t)n);
+    }
+    uint32_t len, crc;
+    memcpy(&len, h->rbuf.data(), 4);
+    memcpy(&crc, h->rbuf.data() + 4, 4);
+    std::string payload = h->rbuf.substr(8, len);
+    h->rbuf.erase(0, 8 + len);
+    if (crc32c((const uint8_t*)payload.data(), payload.size()) != crc)
+      return false;
+    Reader r{(const uint8_t*)payload.data(),
+             (const uint8_t*)payload.data() + payload.size()};
+    // The server's first frame is its ConnectPacket: skip it.
+    if (payload.size() >= 8 && memcmp(payload.data(), "FDBTPU\x00\x01", 8) == 0)
+      continue;
+    uint8_t kind = r.u8();
+    if (kind != 1) continue;  // not a reply (nothing else expected)
+    uint64_t token = r.u64();
+    uint8_t err = r.u8();
+    if (token != reply_token) continue;  // stale reply from a prior op
+    *is_err = err != 0;
+    value_out.assign((const char*)r.p, (size_t)(r.end - r.p));
+    return true;
+  }
+}
+
+// Decode a reply value; on T_ERROR records the code in h->last_error.
+// Returns tag, with ints in *iv and bytes in *bv.
+int decode_value(Handle* h, const std::string& v, int64_t* iv,
+                 std::string* bv) {
+  Reader r{(const uint8_t*)v.data(),
+           (const uint8_t*)v.data() + v.size()};
+  uint8_t tag = r.u8();
+  switch (tag) {
+    case T_NONE: return T_NONE;
+    case T_INT: *iv = r.i64(); return T_INT;
+    case T_BYTES: *bv = r.bytes(); return T_BYTES;
+    case T_ERROR: {
+      h->last_error = (int)r.u32();
+      return T_ERROR;
+    }
+    case T_OBJ: {
+      // CommitID{version, versionstamp}: pull the version field.
+      std::string cls = r.bytes();  // str == bytes wire-wise
+      uint32_t nf = r.u32();
+      for (uint32_t i = 0; i < nf && !r.fail; i++) {
+        std::string fname = r.bytes();
+        Reader save = r;
+        uint8_t ftag = r.u8();
+        if (fname == "version" && ftag == T_INT) {
+          *iv = r.i64();
+          return T_OBJ;
+        }
+        // skip one value (supports the subset replies actually use)
+        r = save;
+        uint8_t t2 = r.u8();
+        if (t2 == T_INT) r.i64();
+        else if (t2 == T_BYTES || t2 == T_STR) r.bytes();
+        else if (t2 == T_NONE || t2 == T_TRUE || t2 == T_FALSE) {}
+        else return -1;
+      }
+      return T_OBJ;
+    }
+    default: return -1;
+  }
+}
+
+std::string envelope(uint64_t token, uint64_t reply_token,
+                     const std::string& obj) {
+  Buf b;
+  b.u8(0);  // request
+  b.u64(token);
+  b.u64(reply_token);
+  b.str("0.0.0.0:0");
+  b.s += obj;
+  return b.s;
+}
+
+std::string obj_header(Buf& b, const std::string& cls, uint32_t n_fields) {
+  b.u8(T_OBJ);
+  b.str(cls);
+  b.u32(n_fields);
+  return b.s;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* fdbc_connect(const char* host, int port) {
+  auto* h = new Handle();
+  h->fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (h->fd < 0) { delete h; return nullptr; }
+  int one = 1;
+  setsockopt(h->fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+  sockaddr_in sa{};
+  sa.sin_family = AF_INET;
+  sa.sin_port = htons((uint16_t)port);
+  if (inet_pton(AF_INET, host, &sa.sin_addr) != 1 ||
+      connect(h->fd, (sockaddr*)&sa, sizeof sa) != 0) {
+    close(h->fd);
+    delete h;
+    return nullptr;
+  }
+  return h;
+}
+
+void fdbc_destroy(void* hp) {
+  auto* h = (Handle*)hp;
+  if (h == nullptr) return;  // free(NULL)-style: failed connect cleanup
+  if (h->fd >= 0) close(h->fd);
+  delete h;
+}
+
+int fdbc_last_error(void* hp) { return ((Handle*)hp)->last_error; }
+
+// -1 on transport error; else the read version.
+int64_t fdbc_get_read_version(void* hp) {
+  auto* h = (Handle*)hp;
+  uint64_t rt = h->next_reply++;
+  Buf obj;
+  obj_header(obj, "GetReadVersionRequest", 0);
+  if (!send_frame(h, envelope(kTokenGRV, rt, obj.s))) return -1;
+  std::string v; bool err = false;
+  if (!recv_reply(h, rt, v, &err)) return -1;
+  int64_t iv = -1; std::string bv;
+  int tag = decode_value(h, v, &iv, &bv);
+  if (err || tag != T_INT) return -1;
+  return iv;
+}
+
+// 1 = found (value copied into handle storage), 0 = absent, -1 = error.
+int fdbc_get(void* hp, const uint8_t* key, uint32_t klen, int64_t version,
+             const uint8_t** out, uint32_t* out_len) {
+  auto* h = (Handle*)hp;
+  uint64_t rt = h->next_reply++;
+  Buf obj;
+  obj_header(obj, "GetValueRequest", 2);
+  obj.str("key"); obj.v_bytes(key, klen);
+  obj.str("version"); obj.v_int(version);
+  if (!send_frame(h, envelope(kTokenRead, rt, obj.s))) return -1;
+  std::string v; bool err = false;
+  if (!recv_reply(h, rt, v, &err)) return -1;
+  int64_t iv = 0;
+  int tag = decode_value(h, v, &iv, &h->last_value);
+  if (err) return -1;
+  if (tag == T_NONE) return 0;
+  if (tag != T_BYTES) return -1;
+  *out = (const uint8_t*)h->last_value.data();
+  *out_len = (uint32_t)h->last_value.size();
+  return 1;
+}
+
+void fdbc_tr_set(void* hp, const uint8_t* k, uint32_t klen,
+                 const uint8_t* v, uint32_t vlen) {
+  auto* h = (Handle*)hp;
+  h->pending.push_back({0, std::string((const char*)k, klen),
+                        std::string((const char*)v, vlen)});
+}
+
+void fdbc_tr_clear_range(void* hp, const uint8_t* b, uint32_t blen,
+                         const uint8_t* e, uint32_t elen) {
+  auto* h = (Handle*)hp;
+  h->pending.push_back({1, std::string((const char*)b, blen),
+                        std::string((const char*)e, elen)});
+}
+
+// Commit buffered mutations at `read_snapshot` with the given read
+// conflict key (or none if rk==nullptr). Returns the commit version,
+// -1 transport error, -2 server-reported error (see fdbc_last_error).
+int64_t fdbc_commit(void* hp, int64_t read_snapshot,
+                    const uint8_t* rk, uint32_t rklen) {
+  auto* h = (Handle*)hp;
+  uint64_t rt = h->next_reply++;
+  Buf obj;
+  obj_header(obj, "CommitTransactionRequest", 4);
+  obj.str("read_snapshot"); obj.v_int(read_snapshot);
+  obj.str("read_conflict_ranges");
+  if (rk != nullptr) {
+    obj.u8(T_LIST); obj.u32(1);
+    obj.u8(T_OBJ); obj.str("KeyRange"); obj.u32(2);
+    obj.str("begin"); obj.v_bytes(rk, rklen);
+    std::string after((const char*)rk, rklen); after.push_back('\0');
+    obj.str("end"); obj.v_bytes((const uint8_t*)after.data(),
+                                (uint32_t)after.size());
+  } else {
+    obj.u8(T_LIST); obj.u32(0);
+  }
+  obj.str("write_conflict_ranges");
+  obj.u8(T_LIST); obj.u32(0);
+  obj.str("mutations");
+  obj.u8(T_LIST); obj.u32((uint32_t)h->pending.size());
+  for (auto& m : h->pending) {
+    obj.u8(T_OBJ); obj.str("Mutation"); obj.u32(3);
+    obj.str("type"); obj.v_enum("MutationType", m.type);
+    obj.str("param1"); obj.v_bytes((const uint8_t*)m.p1.data(),
+                                   (uint32_t)m.p1.size());
+    obj.str("param2"); obj.v_bytes((const uint8_t*)m.p2.data(),
+                                   (uint32_t)m.p2.size());
+  }
+  h->pending.clear();
+  if (!send_frame(h, envelope(kTokenCommit, rt, obj.s))) return -1;
+  std::string v; bool err = false;
+  if (!recv_reply(h, rt, v, &err)) return -1;
+  int64_t iv = -1; std::string bv;
+  int tag = decode_value(h, v, &iv, &bv);
+  if (err || tag == T_ERROR) return -2;
+  if (tag != T_OBJ) return -1;
+  return iv;
+}
+
+}  // extern "C"
