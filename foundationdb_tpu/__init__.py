@@ -9,17 +9,33 @@ batched interval-overlap kernel under JAX (jit/vmap) on TPU, resolving
 simulation-first runtime (flow/ equivalent), a versioned commit pipeline,
 MVCC storage, and multi-resolver sharding over a jax device mesh.
 
-Layer map (mirrors reference layers, TPU-first mechanisms):
-  core/      — deterministic cooperative runtime: futures, virtual-time event
-               loop, seeded randomness, trace events, knobs (ref: flow/)
-  ops/       — JAX/TPU data-plane kernels: key encoding, conflict detection
-               (ref: fdbserver/SkipList.cpp, ConflictSet.h)
-  parallel/  — device-mesh sharding: multi-resolver key-space partition
-               (ref: resolver partitioning, MasterProxyServer.actor.cpp:233)
-  cluster/   — roles: sequencer, proxy, resolver, tlog, storage, recovery
-               (ref: fdbserver/)
-  client/    — transaction API: GRV, reads, RYW, commit, retry loop
-               (ref: fdbclient/NativeAPI.actor.cpp, ReadYourWrites.actor.cpp)
+Layer map (mirrors reference layers, TPU-first mechanisms; see README.md
+for the full file-by-file reference map):
+  core/           — deterministic cooperative runtime: futures, event loop,
+                    seeded randomness, trace, knobs, serialization, profiler
+                    (ref: flow/)
+  net/, sim/      — the INetwork seam: real TCP FlowTransport + TLS on one
+                    side, the fault-injecting simulated network + nondurable
+                    disks on the other (ref: fdbrpc/)
+  resolver/       — THE north star: the conflict-set kernels (CPU oracle,
+                    TPU fused-buffer kernel, rank-fed alternative, mesh-
+                    sharded) (ref: fdbserver/SkipList.cpp, ConflictSet.h)
+  cluster/        — roles + control plane: master, proxy, resolver role,
+                    tag-partitioned logs, MVCC storage, coordination,
+                    recovery generations, DD/MoveKeys, ratekeeper, status,
+                    management, discovery (ref: fdbserver/, fdbclient/)
+  client/         — transactions: GRV, RYW reads, options, load-balanced
+                    sharded routing, retry loop, thread-safe facade
+                    (ref: fdbclient/NativeAPI, ReadYourWrites)
+  kv/, layers/    — keys/ranges, versioned map, indexed set, atomics; tuple/
+                    subspace/directory/TaskBucket layers (ref: fdbclient/)
+  storage_engine/ — durable tier: native DiskQueue, memory engine, native
+                    COW-B+tree ssd engine (ref: fdbserver engines)
+  workloads/      — invariant/perf/churn workloads + the spec-driven tester
+                    (ref: fdbserver/workloads/, tester.actor.cpp)
+  api.py          — the fdb-style binding surface; server.py — the role-host
+                    entrypoint; cli.py — the operator shell; backup/dr —
+                    snapshots, containers, log-shipping replication
 """
 
 __version__ = "0.1.0"
