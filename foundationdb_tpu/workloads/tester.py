@@ -34,6 +34,70 @@ class SpecError(ValueError):
     pass
 
 
+class _AttritionWorkload:
+    """Periodic transaction-system kills (ref: workloads/MachineAttrition —
+    which also waits for the cluster to heal between kills)."""
+
+    def __init__(self, cluster, interval: float, kills: int,
+                 name: str = "attrition-cc"):
+        self.cluster = cluster
+        self.interval = interval
+        self.max_kills = kills
+        self.name = name
+        self.kills_done = 0
+        self._baseline = 0
+        self._task = None
+        self._stopping = False
+
+    def start(self):
+        # Unique controller name per instance: LeaderElection arbitrates
+        # BY NAME, so two candidates sharing one name would both believe
+        # they hold the lease.
+        self.cluster.start_controller(self.name)
+        self._baseline = self.cluster.recoveries_done
+        self._task = spawn(self._run(), name="attrition")
+        return self
+
+    def stop(self):
+        self._stopping = True
+
+    async def wait_stopped(self):
+        if self._task is not None:
+            await self._task.done
+
+    async def _kill_and_await_recovery(self, loop):
+        target = self._baseline + self.kills_done + 1
+        self.cluster.kill_transaction_system()
+        self.kills_done += 1
+        # Wait for the recovery before the next kill — killing an
+        # already-dead system is a no-op that would desync the count
+        # (the reference workload heals between kills too).
+        deadline = loop.now() + 60.0
+        while self.cluster.recoveries_done < target and loop.now() < deadline:
+            await loop.delay(0.1)
+
+    async def _run(self):
+        from ..core.runtime import current_loop
+
+        loop = current_loop()
+        while not self._stopping and self.kills_done < self.max_kills:
+            await loop.delay(self.interval * (0.7 + 0.6 * loop.random.random01()))
+            if self._stopping:
+                break
+            await self._kill_and_await_recovery(loop)
+        if self.kills_done == 0:
+            # The workloads outran the first interval: still exercise at
+            # least one kill+recovery (that is the workload's purpose).
+            await self._kill_and_await_recovery(loop)
+
+    async def check(self) -> bool:
+        return (
+            self.kills_done >= 1
+            and self.cluster.recoveries_done
+            >= self._baseline + self.kills_done
+        )
+
+
 async def _run_workloads(cluster, db, spec) -> dict[str, Any]:
     from .consistency_check import ConsistencyCheckWorkload
     from .cycle import CycleWorkload
@@ -89,6 +153,19 @@ async def _run_workloads(cluster, db, spec) -> dict[str, Any]:
             stoppers.append((wl.stop, wl.wait_stopped))
             checkers.append((rkey, wl.check,
                              lambda wl=wl: {"moves": wl.moves_done}))
+        elif name == "Attrition":
+            # Kill the transaction system on an interval; the controller
+            # must recover each generation (ref: MachineAttrition.actor.cpp
+            # — kills DURING the correctness workloads).
+            if not hasattr(cluster, "kill_transaction_system"):
+                raise SpecError("Attrition needs a recoverable cluster")
+            wl = _AttritionWorkload(
+                cluster, interval=w.get("interval", 1.0),
+                kills=w.get("kills", 2), name=f"attrition-cc-{rkey}",
+            ).start()
+            stoppers.append((wl.stop, wl.wait_stopped))
+            checkers.append((rkey, wl.check,
+                             lambda wl=wl: {"kills": wl.kills_done}))
         elif name == "DataDistribution":
             dd = cluster.start_data_distribution(
                 interval=w.get("interval", 0.2)
@@ -150,6 +227,10 @@ def run_spec(spec: dict) -> dict[str, Any]:
                 from ..cluster.sharded_cluster import ShardedKVCluster
 
                 cluster = ShardedKVCluster(**ckw).start()
+            elif ckind == "recoverable_sharded":
+                from ..cluster.recovery import RecoverableShardedCluster
+
+                cluster = RecoverableShardedCluster(**ckw).start()
             elif ckind == "local":
                 from ..cluster.cluster import LocalCluster
 

@@ -60,7 +60,8 @@ def test_knob_flag_applies(tmp_path):
 
 
 @pytest.mark.parametrize(
-    "spec", ["readwrite_local.json", "cycle_churn.json"]
+    "spec",
+    ["readwrite_local.json", "cycle_churn.json", "attrition_cycle.json"]
 )
 def test_checked_in_specs_pass(spec):
     import os

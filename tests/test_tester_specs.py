@@ -68,3 +68,29 @@ def test_unknown_workload_rejected():
     with pytest.raises(SpecError):
         run_spec({"cluster": {"kind": "local"},
                   "workloads": [{"name": "Nope"}]})
+
+
+def test_attrition_spec_recovers_and_stays_consistent():
+    """Kill-during-workload (the reference's Attrition spec shape): the
+    controller must recover each generation, the Cycle invariant must
+    hold, and replicas must converge."""
+    res = run_spec({
+        "seed": 77,
+        "buggify": True,
+        "cluster": {"kind": "recoverable_sharded", "n_storage": 4,
+                    "n_logs": 2, "replication": "double"},
+        "workloads": [
+            {"name": "Cycle", "nodes": 14, "clients": 3, "txns": 20},
+            {"name": "Attrition", "interval": 0.8, "kills": 2},
+        ],
+    })
+    assert res["ok"], res
+    assert res["Attrition"]["metrics"]["kills"] >= 1
+    assert res["ConsistencyCheck"]["ok"]
+
+
+def test_attrition_requires_recoverable_cluster():
+    with pytest.raises(SpecError):
+        run_spec({"cluster": {"kind": "sharded", "n_storage": 4,
+                              "n_logs": 2, "replication": "double"},
+                  "workloads": [{"name": "Attrition"}]})
