@@ -58,10 +58,10 @@ class ClusterConnection:
                 CLIENT_KNOBS.DEFAULT_MAX_BACKOFF,
             )
 
-    async def get_read_version(self) -> int:
+    async def get_read_version(self, priority: int = 1) -> int:
         return await self._retrying(
-            GetReadVersionRequest, self.grv_endpoint,
-            CLIENT_KNOBS.GRV_TIMEOUT,
+            lambda: GetReadVersionRequest(priority=priority),
+            self.grv_endpoint, CLIENT_KNOBS.GRV_TIMEOUT,
         )
 
     async def get_value(self, key: bytes, version: int):

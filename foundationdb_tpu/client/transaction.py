@@ -187,10 +187,21 @@ class Transaction:
 
     # -- versions --
     def get_read_version(self) -> Future:
-        """GRV; batched proxy-side (ref: readVersionBatcher :2700)."""
+        """GRV; batched proxy-side (ref: readVersionBatcher :2700).
+        Priority options map onto the request's priority band."""
         self._check_usable()
         if self._read_version_f is None:
-            self._grv_task = spawn(self._db.conn.get_read_version(), name="grv")
+            from ..cluster.interfaces import GetReadVersionRequest as GRV
+            from ..options import TransactionOptions as TO
+
+            priority = GRV.PRIORITY_DEFAULT
+            if self._option(TO.PRIORITY_SYSTEM_IMMEDIATE):
+                priority = GRV.PRIORITY_IMMEDIATE
+            elif self._option(TO.PRIORITY_BATCH):
+                priority = GRV.PRIORITY_BATCH
+            self._grv_task = spawn(
+                self._db.conn.get_read_version(priority), name="grv"
+            )
             self._read_version_f = self._grv_task.done
         return self._read_version_f
 

@@ -30,8 +30,15 @@ class Mutation:
 
 @dataclass
 class GetReadVersionRequest:
-    """(ref: GetReadVersionRequest, MasterProxyInterface.h:122)."""
+    """(ref: GetReadVersionRequest, MasterProxyInterface.h:122; priorities
+    :122 PRIORITY_SYSTEM_IMMEDIATE/DEFAULT/BATCH — immediate bypasses
+    ratekeeper throttling, batch yields to everything else)."""
 
+    PRIORITY_BATCH = 0
+    PRIORITY_DEFAULT = 1
+    PRIORITY_IMMEDIATE = 2
+
+    priority: int = 1
     reply: Promise = field(default_factory=Promise)
 
 

@@ -149,9 +149,16 @@ class CommitProxy:
         # Admission control: when the ratekeeper's budget is exhausted the
         # batch is deferred, not denied — GRVs simply start later, which is
         # exactly how the reference's transactionStarter applies the rate
-        # (MasterProxyServer.actor.cpp:85-150).
+        # (MasterProxyServer.actor.cpp:85-150). SYSTEM_IMMEDIATE requests
+        # bypass the budget entirely (recovery/management traffic must not
+        # be throttled by the very overload it is fixing); BATCH priority
+        # yields first when the budget runs short.
+        hi = GetReadVersionRequest.PRIORITY_IMMEDIATE
+        immediate = [r for r in reqs if getattr(r, "priority", 1) >= hi]
+        reqs = [r for r in reqs if getattr(r, "priority", 1) < hi]
+        reqs.sort(key=lambda r: -getattr(r, "priority", 1))  # batch last
         rk = self.ratekeeper
-        if rk is not None:
+        if rk is not None and reqs:
             admitted = rk.admit_transactions(len(reqs))
             if admitted < len(reqs):
                 deferred = reqs[admitted:]
@@ -170,8 +177,9 @@ class CommitProxy:
                 self._tasks.add(
                     spawn(requeue(), TaskPriority.GRV, name="grvThrottle")
                 )
-                if not reqs:
-                    return
+        reqs = immediate + reqs
+        if not reqs:
+            return
         v = self.master.get_live_committed_version()
         TraceEvent("ProxyGRV").detail("Version", v).detail(
             "Count", len(reqs)

@@ -85,12 +85,15 @@ class _AttritionWorkload:
             if self._stopping:
                 break
             await self._kill_and_await_recovery(loop)
-        if self.kills_done == 0:
+        if self.kills_done == 0 and self.max_kills > 0:
             # The workloads outran the first interval: still exercise at
             # least one kill+recovery (that is the workload's purpose).
+            # kills: 0 means "present but disabled" and is honored.
             await self._kill_and_await_recovery(loop)
 
     async def check(self) -> bool:
+        if self.max_kills == 0:
+            return self.kills_done == 0
         return (
             self.kills_done >= 1
             and self.cluster.recoveries_done
@@ -104,6 +107,7 @@ async def _run_workloads(cluster, db, spec) -> dict[str, Any]:
     from .random_move_keys import RandomMoveKeysWorkload
     from .read_write import ReadWriteWorkload
     from .serializability import SerializabilityWorkload
+    from .watches import WatchesWorkload
 
     results: dict[str, Any] = {}
     starters = []   # (name, coroutine-future) start phases to await
@@ -153,6 +157,13 @@ async def _run_workloads(cluster, db, spec) -> dict[str, Any]:
             stoppers.append((wl.stop, wl.wait_stopped))
             checkers.append((rkey, wl.check,
                              lambda wl=wl: {"moves": wl.moves_done}))
+        elif name == "Watches":
+            wl = WatchesWorkload(db, pairs=w.get("pairs", 8),
+                                 rounds=w.get("rounds", 3))
+            starters.append((rkey, spawn(wl.run()).done))
+            checkers.append((rkey, wl.check,
+                             lambda wl=wl: {"fires": wl.fires,
+                                            "wrong": wl.wrong_fires}))
         elif name == "Attrition":
             # Kill the transaction system on an interval; the controller
             # must recover each generation (ref: MachineAttrition.actor.cpp

@@ -1,0 +1,83 @@
+"""Watches workload (ref: fdbserver/workloads/Watches.actor.cpp — chains
+of watchers where each fired watch triggers the next write, validating
+that watches fire exactly when their key actually changed).
+
+N watcher/writer pairs: each watcher registers a watch on its key, the
+writer then changes the key; the watch must fire, and the value read
+after firing must be the new one. A decoy key that never changes checks
+that its watch does NOT fire."""
+
+from __future__ import annotations
+
+from ..client.database import Database
+from ..core.actors import all_of, timeout
+from ..core.runtime import current_loop, spawn
+
+
+class WatchesWorkload:
+    def __init__(self, db: Database, pairs: int = 8, rounds: int = 3,
+                 prefix: bytes = b"watch/"):
+        self.db = db
+        self.pairs = pairs
+        self.rounds = rounds
+        self.prefix = prefix
+        self.fires = 0
+        self.wrong_fires = 0
+        self.decoy_fired = False
+
+    def _key(self, i: int) -> bytes:
+        return self.prefix + b"%03d" % i
+
+    async def _pair(self, i: int) -> None:
+        loop = current_loop()
+        for r in range(self.rounds):
+            old = b"r%d" % r
+            new = b"r%d" % (r + 1)
+
+            async def seed(tr):
+                tr.set(self._key(i), old)
+
+            await self.db.transact(seed)
+
+            tr = self.db.create_transaction()
+            got = await tr.get(self._key(i))
+            assert got == old
+            w = tr.watch(self._key(i))
+            await tr.commit()
+
+            async def write_later():
+                await loop.delay(0.05 * loop.random.random01())
+                await self.db.set(self._key(i), new)
+
+            writer = spawn(write_later())
+            await w.wait()
+            await writer.done
+            after = await self.db.get(self._key(i))
+            if after == new:
+                self.fires += 1
+            else:
+                self.wrong_fires += 1
+
+    async def run(self) -> None:
+        # Decoy: a watch on a never-changing key must stay pending.
+        await self.db.set(self.prefix + b"decoy", b"still")
+        tr = self.db.create_transaction()
+        await tr.get(self.prefix + b"decoy")
+        decoy = tr.watch(self.prefix + b"decoy")
+        await tr.commit()
+
+        tasks = [spawn(self._pair(i), name=f"watch_pair_{i}")
+                 for i in range(self.pairs)]
+        await all_of([t.done for t in tasks])
+
+        decoy_task = spawn(decoy.wait(), name="decoy")
+        fired = await timeout(decoy_task.done, 0.5, default=None)
+        self.decoy_fired = fired is not None
+        decoy_task.cancel()  # don't leak the watcher past the probe
+
+    async def check(self) -> bool:
+        return (
+            self.fires == self.pairs * self.rounds
+            and self.wrong_fires == 0
+            and not self.decoy_fired
+        )

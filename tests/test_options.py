@@ -160,3 +160,34 @@ def test_ryw_disable_applies_to_ranges_too(sim):
         c.stop()
 
     sim.run(main())
+
+
+def test_grv_priority_immediate_bypasses_throttle(sim):
+    """PRIORITY_SYSTEM_IMMEDIATE GRVs must be served even with the
+    ratekeeper budget at zero (ref: transactionStarter's priority bands,
+    MasterProxyServer.actor.cpp:122)."""
+
+    async def main():
+        c = LocalCluster().start()
+        db = c.database()
+        await db.set(b"seed", b"1")
+        # Jam the budget shut.
+        c.ratekeeper.tps_limit = 0.0
+        c.ratekeeper._tokens = 0.0
+        c.ratekeeper.stop()  # keep it from recomputing
+
+        tr = db.create_transaction()
+        tr.options.set_priority_system_immediate()
+        v = await tr.get_read_version()
+        assert v > 0  # answered despite the zero budget
+
+        from foundationdb_tpu.core.actors import timeout
+
+        tr2 = db.create_transaction()
+        got = await timeout(tr2.get_read_version(), 0.4, default=None)
+        assert got is None  # default priority is throttled
+        tr2.reset()
+        c.ratekeeper.tps_limit = float("inf")
+        c.stop()
+
+    sim.run(main())

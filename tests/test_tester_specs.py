@@ -94,3 +94,15 @@ def test_attrition_requires_recoverable_cluster():
         run_spec({"cluster": {"kind": "sharded", "n_storage": 4,
                               "n_logs": 2, "replication": "double"},
                   "workloads": [{"name": "Attrition"}]})
+
+
+def test_watches_spec_on_sharded_cluster():
+    res = run_spec({
+        "seed": 13,
+        "cluster": {"kind": "sharded", "n_storage": 4, "n_logs": 2,
+                    "replication": "double",
+                    "shard_boundaries": [b"watch/004"]},
+        "workloads": [{"name": "Watches", "pairs": 8, "rounds": 3}],
+    })
+    assert res["ok"], res
+    assert res["Watches"]["metrics"]["fires"] == 24
