@@ -374,6 +374,14 @@ class CommitProxy:
 
         # Phase 3: merge verdicts, build the log payload; interpret
         # committed system-keyspace mutations (ApplyMetadataMutation).
+        # Applied PRE-push like the reference's proxy-side
+        # applyMetadataMutations: later batches' routing must see the new
+        # config immediately. The fenced-commit hazard (a TLogStopped push
+        # leaves never-durable effects in the caches) is handled the way
+        # the reference handles it — a fence always coincides with a
+        # recovery, and recovery re-derives the caches from durable state
+        # (RecoverableShardedCluster._rebuild_metadata_caches, the
+        # txnStateStore-rebuild analogue).
         mutations = []
         for r, status in zip(reqs, result.statuses):
             if status == COMMITTED:
@@ -381,7 +389,7 @@ class CommitProxy:
                 if self.metadata_hook is not None:
                     for m in r.mutations:
                         if m.param1.startswith(b"\xff"):
-                            self.metadata_hook(m)
+                            self.metadata_hook(m, version)
         if buggify("proxy_commit_delay"):
             await loop.delay(0.05 * loop.random.random01())
 

@@ -444,9 +444,87 @@ class RecoverableShardedCluster:
             "storage": {s.tag: s.read_stream for s in inner.storages},
         })
         self.recoveries_done += 1
+        # Discard never-durable metadata effects: a commit whose push was
+        # fenced by THIS recovery may have updated the in-memory config
+        # caches pre-push (proxy phase 3). Re-derive them from durable
+        # state, the analogue of the reference rebuilding txnStateStore
+        # from the recovered log during recovery. The version watermark is
+        # clamped first: a phantom effect may carry a version no storage
+        # will ever reach (its commit never became durable), and the
+        # rebuild's read must wait only on reachable versions.
+        inner.metadata_version = min(inner.metadata_version, start_version)
+        spawn(
+            self._rebuild_metadata_caches(start_version),
+            TaskPriority.DEFAULT,
+            name="metadataRebuild",
+        )
         TraceEvent("RecoveryComplete").detail("Generation", generation).detail(
             "RecoveryVersion", recovery_version
         ).detail("Sharded", True).log()
+
+    async def _rebuild_metadata_caches(self, recovery_version: int) -> None:
+        """Replace the \\xff-derived config caches (excluded servers +
+        configuration values) with what durable storage holds. Retries
+        while commits race the read: the caches' `metadata_version` tells
+        whether a newer effect landed after our read version."""
+        from ..core.errors import TransactionTooOld, WrongShardServer
+        from ..kv.keys import KeyRange, strinc
+        from .interfaces import GetRangeRequest
+        from .system_data import (
+            CONF_PREFIX,
+            EXCLUDED_PREFIX,
+            decode_config_key,
+            decode_excluded_server_key,
+        )
+
+        inner = self.inner
+        generation = self.generation
+        by_tag = {s.tag: s for s in inner.storages}
+        begin, end = CONF_PREFIX, strinc(CONF_PREFIX)
+        loop = current_loop()
+        while self.generation == generation:
+            target = max(recovery_version, inner.metadata_version)
+            try:
+                rows: list = []
+                for lo, hi, team in inner.shard_map.intersecting(
+                    KeyRange(begin, end)
+                ):
+                    s = next(
+                        (by_tag[t] for t in team if t in by_tag), None
+                    )
+                    if s is None:
+                        raise WrongShardServer()
+                    rows.extend(
+                        await s.get_range(GetRangeRequest(
+                            begin=max(lo, begin), end=min(hi, end),
+                            version=target,
+                        ))
+                    )
+            except (WrongShardServer, TransactionTooOld):
+                await loop.delay(0.05)
+                continue
+            if self.generation != generation:
+                return
+            if inner.metadata_version > target:
+                continue  # a commit raced the read; re-derive
+            excluded: set[int] = set()
+            conf: dict[str, str] = {}
+            for k, v in rows:
+                if k.startswith(EXCLUDED_PREFIX):
+                    excluded.add(decode_excluded_server_key(k))
+                elif k.startswith(CONF_PREFIX):
+                    conf[decode_config_key(k)] = v.decode()
+            # In place: other roles hold references to these objects.
+            inner.excluded.clear()
+            inner.excluded.update(excluded)
+            inner.config_values.clear()
+            inner.config_values.update(conf)
+            TraceEvent("MetadataCachesRebuilt").detail(
+                "Version", target
+            ).detail("Excluded", len(excluded)).detail(
+                "ConfValues", len(conf)
+            ).log()
+            return
 
     # -- the controller (identical contract to RecoverableCluster's) --
     start_controller = RecoverableCluster.start_controller

@@ -98,6 +98,10 @@ class ShardedKVCluster:
         # mutations (ref: DatabaseConfiguration fed by ApplyMetadataMutation).
         self.config_values: dict[str, str] = {}
         self.excluded: set[int] = set()
+        # Version of the newest metadata effect applied to the caches;
+        # lets the recovery-time rebuild detect (and retry over) a
+        # concurrent commit racing its durable-state read.
+        self.metadata_version = 0
         self.proxy.metadata_hook = self._apply_metadata
         self.dd = None
         # One mover at a time across DD and test/ops tooling (ref:
@@ -116,7 +120,7 @@ class ShardedKVCluster:
         self.proxy.start()
         return self
 
-    def _apply_metadata(self, m) -> None:
+    def _apply_metadata(self, m, version: int = 0) -> None:
         """(ref: applyMetadataMutations — interpret committed \\xff writes
         into live config: exclusions + configuration values)."""
         from ..kv.atomic import MutationType
@@ -129,6 +133,7 @@ class ShardedKVCluster:
 
         from .system_data import excluded_server_key
 
+        self.metadata_version = max(self.metadata_version, version)
         if m.type == MutationType.SET_VALUE:
             if m.param1.startswith(EXCLUDED_PREFIX):
                 self.excluded.add(decode_excluded_server_key(m.param1))

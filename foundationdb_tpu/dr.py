@@ -101,6 +101,16 @@ class DRAgent:
                 if ms:
                     async def apply(dtr, ms=ms, v=version):
                         dtr.options.set_access_system_keys()
+                        # Idempotence guard: a CommitUnknownResult retry
+                        # re-runs this body after the commit may have landed;
+                        # re-applying atomic ops (ADD, ...) would silently
+                        # diverge the replica. The applied-version register
+                        # is written in the same transaction, so `>= v`
+                        # proves this version is already in (ref: the
+                        # agent's applyMutations applied-version tracking).
+                        cur = await dtr.get(DR_VERSION_KEY)
+                        if cur is not None and int(cur) >= v:
+                            return
                         for m in ms:
                             if m.type == MutationType.SET_VALUE:
                                 dtr.set(m.param1, m.param2)

@@ -245,9 +245,13 @@ class DirectoryLayer:
         b, e = node[SUBDIRS].range()
         for k, child_prefix in await tr.get_range(b, e):
             await self._remove_subtree(tr, self._node(child_prefix))
+        from ..kv.keys import strinc
+
         prefix = await self._node_prefix(node)
-        # Content + node metadata.
-        tr.clear_range(prefix, prefix + b"\xff")
+        # Content + node metadata. The end is strinc(prefix) — the first key
+        # NOT prefixed — so raw suffixes starting with 0xff don't survive
+        # removal (ref: the reference clears [prefix, strinc(prefix))).
+        tr.clear_range(prefix, strinc(prefix))
         nb, ne = node.range()
         tr.clear_range(nb, ne)
         tr.clear(node.key())

@@ -5,8 +5,11 @@ time-limited leases and re-queued when an executor dies).
 
 Layout under the bucket subspace (mirroring the reference's shape):
 
-    available/<priority>/<task_id>        -> packed params
-    timeouts/<lease_version>/<task_id>    -> packed params  (claimed)
+    available/<priority>/<task_id>             -> packed params
+    timeouts/<lease_version>/<task_id>/<prio>  -> packed params  (claimed)
+
+The claimed entry carries the task's priority so a lease-timeout requeue
+restores it (the reference preserves priority across checkTimeouts).
 
 Claiming moves a task from `available` to `timeouts` keyed by the lease
 expiry version; `finish` deletes it; an expired lease is swept back to
@@ -94,16 +97,20 @@ class TaskBucket:
             + SERVER_KNOBS.TASKBUCKET_TIMEOUT_VERSIONS
         )
         tr.clear(k)
-        tr.set(self.timeouts.pack((lease, task_id)), v)
+        tr.set(self.timeouts.pack((lease, task_id, priority)), v)
         return Task(task_id, priority, _unpack_params(v), lease)
 
     def finish(self, tr, task: Task) -> None:
         """(ref: TaskBucket::finish) — done; drop the lease entry."""
-        tr.clear(self.timeouts.pack((task.lease_version, task.id)))
+        tr.clear(
+            self.timeouts.pack((task.lease_version, task.id, task.priority))
+        )
 
     async def extend(self, tr, task: Task) -> Task:
         """Renew the lease of a long-running task (ref: extendTimeout)."""
-        old_key = self.timeouts.pack((task.lease_version, task.id))
+        old_key = self.timeouts.pack(
+            (task.lease_version, task.id, task.priority)
+        )
         raw = await tr.get(old_key)
         if raw is None:
             raise KeyError("lease lost (timed out and reclaimed)")
@@ -112,7 +119,7 @@ class TaskBucket:
             + SERVER_KNOBS.TASKBUCKET_TIMEOUT_VERSIONS
         )
         tr.clear(old_key)
-        tr.set(self.timeouts.pack((new_lease, task.id)), raw)
+        tr.set(self.timeouts.pack((new_lease, task.id, task.priority)), raw)
         return Task(task.id, task.priority, task.params, new_lease)
 
     async def sweep_timeouts(self, tr) -> int:
@@ -123,9 +130,9 @@ class TaskBucket:
         e = self.timeouts.pack((rv,))
         rows = await tr.get_range(b, e)
         for k, v in rows:
-            _, task_id = self.timeouts.unpack(k)
+            _, task_id, priority = self.timeouts.unpack(k)
             tr.clear(k)
-            tr.set(self.available.pack((0, task_id)), v)
+            tr.set(self.available.pack((priority, task_id)), v)
         return len(rows)
 
     async def is_empty(self, tr) -> bool:

@@ -136,17 +136,25 @@ bool send_frame(Handle* h, const std::string& payload) {
 // payload AFTER the (kind, token, is_err) header, setting *is_err.
 bool recv_reply(Handle* h, uint64_t reply_token, std::string& value_out,
                 bool* is_err) {
+  // Mirror of the Python transport's _MAX_FRAME: a corrupt/hostile length
+  // must fail fast, not buffer gigabytes (the length is untrusted wire
+  // input).
+  constexpr uint32_t kMaxFrame = 64u << 20;
   for (;;) {
     // Fill until one whole frame is available.
-    while (h->rbuf.size() < 8 ||
-           h->rbuf.size() < 8 + *(uint32_t*)h->rbuf.data()) {
+    uint32_t len = 0;
+    for (;;) {
+      if (h->rbuf.size() >= 4) {
+        memcpy(&len, h->rbuf.data(), 4);  // unaligned-safe read
+        if (len > kMaxFrame) return false;
+        if (h->rbuf.size() >= 8 + (size_t)len) break;
+      }
       char tmp[1 << 16];
       ssize_t n = recv(h->fd, tmp, sizeof tmp, 0);
       if (n <= 0) return false;
       h->rbuf.append(tmp, (size_t)n);
     }
-    uint32_t len, crc;
-    memcpy(&len, h->rbuf.data(), 4);
+    uint32_t crc;
     memcpy(&crc, h->rbuf.data() + 4, 4);
     std::string payload = h->rbuf.substr(8, len);
     h->rbuf.erase(0, 8 + len);

@@ -362,3 +362,52 @@ def test_sharded_recovery_quorum_truncation_keeps_replicas_consistent():
                 c.stop()
 
             loop.run(main(), timeout_sim_seconds=600)
+
+
+def test_recovery_discards_phantom_metadata(sim):
+    """A \xff effect applied to the in-memory config caches at proxy phase
+    3 whose push never became durable (the fenced-commit shape) must NOT
+    survive recovery: the caches are re-derived from durable state (the
+    txnStateStore-rebuild analogue; ref ApplyMetadataMutation + recovery's
+    txnStateStore reconstruction)."""
+    from foundationdb_tpu.cluster.interfaces import Mutation
+    from foundationdb_tpu.cluster.management import (
+        exclude_servers,
+        get_excluded_servers,
+    )
+    from foundationdb_tpu.cluster.recovery import RecoverableShardedCluster
+    from foundationdb_tpu.cluster.system_data import excluded_server_key
+    from foundationdb_tpu.core import delay
+    from foundationdb_tpu.kv.atomic import MutationType
+
+    async def main():
+        c = RecoverableShardedCluster(
+            n_storage=4, n_logs=2, replication="double",
+            shard_boundaries=[b"m"],
+        ).start()
+        db = c.database()
+        # A DURABLE exclusion: must survive the rebuild.
+        await exclude_servers(db, [3])
+        await db.set(b"k", b"v")
+        inner = c.inner
+        assert 3 in inner.excluded
+        # The phantom: cache effect without a durable commit behind it.
+        inner._apply_metadata(
+            Mutation(MutationType.SET_VALUE, excluded_server_key(2), b""),
+            version=inner.metadata_version + 1,
+        )
+        assert 2 in inner.excluded
+
+        c.kill_transaction_system()
+        c.start_controller("cc0")
+        await db.set(b"post", b"alive")  # resolves => recovery completed
+        for _ in range(200):  # the rebuild task runs async after recovery
+            if 2 not in inner.excluded:
+                break
+            await delay(0.05)
+        assert 2 not in inner.excluded, "phantom exclusion survived recovery"
+        assert 3 in inner.excluded, "durable exclusion lost by the rebuild"
+        assert await get_excluded_servers(db) == {3}
+        c.stop()
+
+    sim.run(main())
