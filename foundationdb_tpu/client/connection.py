@@ -42,14 +42,23 @@ class ClusterConnection:
         self.storage_endpoint = storage_endpoint
 
     async def _retrying(self, make_req, endpoint, request_timeout: float):
-        """Idempotent request: re-send (a fresh request) on timeout,
-        backing off, forever — progress resumes when the network heals."""
+        """Idempotent request: re-send (a fresh request) on timeout OR
+        connection loss, backing off, forever — progress resumes when the
+        network heals (ref: the client treating broken_promise from a
+        role as a signal to re-resolve and retry, NativeAPI throughout)."""
+        from ..core.errors import BrokenPromise, ConnectionFailed
+
         loop = current_loop()
         backoff = CLIENT_KNOBS.DEFAULT_BACKOFF
         while True:
             req = make_req()
             endpoint.send(req)
-            result = await timeout(req.reply.future, request_timeout, _LOST)
+            try:
+                result = await timeout(
+                    req.reply.future, request_timeout, _LOST
+                )
+            except (ConnectionFailed, BrokenPromise):
+                result = _LOST
             if result is not _LOST:
                 return result
             await loop.delay(backoff * (0.5 + loop.random.random01()))
@@ -84,10 +93,17 @@ class ClusterConnection:
         return req.reply.future
 
     async def commit(self, req: CommitTransactionRequest):
+        from ..core.errors import BrokenPromise, ConnectionFailed
+
         self.commit_endpoint.send(req)
-        result = await timeout(
-            req.reply.future, CLIENT_KNOBS.COMMIT_TIMEOUT, _LOST
-        )
+        try:
+            result = await timeout(
+                req.reply.future, CLIENT_KNOBS.COMMIT_TIMEOUT, _LOST
+            )
+        except (ConnectionFailed, BrokenPromise) as e:
+            # The connection died with the commit in flight: ambiguous
+            # (the proxy may have pushed the batch before the link broke).
+            raise CommitUnknownResult(str(e))
         if result is _LOST:
             # The batch may or may not have committed — the defining OCC
             # client ambiguity (ref: commit_unknown_result).

@@ -76,6 +76,69 @@ class CoordinatorRegister:
         return True
 
 
+class FileCoordinatorRegister(CoordinatorRegister):
+    """Disk-backed register server (ref: the coordinators' OnDemandStore —
+    fdbserver/Coordination.actor.cpp persisting generations to disk so a
+    restarted coordinator keeps its promises).
+
+    Every accepted read promise and write is persisted (write-to-temp +
+    fsync + rename) BEFORE it is acknowledged: a restarted register can
+    never accept a write an earlier incarnation promised away, which is
+    the whole safety story of the generation protocol. Values that aren't
+    JSON-serializable (live endpoint interfaces) are kept in memory only —
+    they are meaningless across a restart by construction.
+    """
+
+    def __init__(self, name: str, path: str):
+        super().__init__(name)
+        self.path = path
+        self._load()
+
+    def _load(self) -> None:
+        import json
+        import os
+
+        if not os.path.exists(self.path):
+            return
+        with open(self.path) as f:
+            raw = json.load(f)
+        for key, (rg, wg, value) in raw.items():
+            self.regs[key] = _RegState(rg, wg, value)
+
+    def _persist(self) -> None:
+        import json
+        import os
+
+        out = {}
+        for key, s in self.regs.items():
+            try:
+                json.dumps(s.value)
+                value = s.value
+            except TypeError:
+                value = None  # transient (live interfaces): gens still kept
+            out[key] = [s.read_gen, s.write_gen, value]
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(out, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    def read(self, key: str, gen: int) -> tuple[Any, int]:
+        s = self._reg(key)
+        bump = gen > s.read_gen
+        out = super().read(key, gen)
+        if bump:
+            self._persist()  # the read PROMISE must survive restart
+        return out
+
+    def write(self, key: str, gen: int, value: Any) -> bool:
+        ok = super().write(key, gen, value)
+        if ok:
+            self._persist()
+        return ok
+
+
 class CoordinatedState:
     """Client side of the quorum protocol for ONE keyed register (ref:
     CoordinatedState + ReusableCoordinatedState, masterserver.actor.cpp:78)."""

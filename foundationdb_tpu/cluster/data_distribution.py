@@ -158,6 +158,7 @@ async def _move_keys_fetch_finish(cluster, r, new_team, old_slices,
         # resurrect rows after the wipe.
         s.set_assigned(r.begin, r.end, False)
         s.data.clear_range(r.begin, r.end, s.version.get())
+        s._log_durable_clear(r.begin, r.end, s.version.get())
         s.metrics.on_clear_range(r.begin, r.end)
     cluster.shard_map.set_team(r, new_team)
     TraceEvent("MoveKeysFinish").detail("Begin", r.begin).detail(

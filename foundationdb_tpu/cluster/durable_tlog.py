@@ -1,0 +1,259 @@
+"""Durable transaction log: TaggedTLog semantics over the DiskQueue.
+
+This is the fsync on the commit critical path (ref:
+fdbserver/TLogServer.actor.cpp:1115 tLogCommit -> DiskQueue push, with
+doQueueCommit :1045 doing the group fsync): a commit batch is appended to
+the two-file page-checksummed DiskQueue and the client's commit resolves
+only after the queue's fsync covers it. A process kill after the ack can
+never lose the batch; a kill before the fsync loses at most un-acked
+batches (the torn queue tail).
+
+Record stream (chunk-framed over 4KiB queue pages, each record a blob of
+one of these kinds, replayed in sequence order at open):
+
+    ENTRY  prev_version, version, [TaggedMutation...]   — one commit batch
+    EPOCH  epoch, durable_at_lock                        — a lock() fence
+    TRUNC  version                                       — quorum truncation
+    POP    tag, version                                  — per-tag ack
+
+EPOCH makes the recovery fence durable: a restarted log refuses commits
+from generations older than its last fence (the reference persists the
+same via its coordinated state + tlog lock state). TRUNC makes the
+epoch-end QUORUM truncation durable (TagPartitionedLogSystem.lock
+discards entries above the min durable version across logs — ref
+epochEnd :107); without it a restart would resurrect entries a subset of
+logs durably held but the quorum never acknowledged, and replicas would
+diverge. POP bounds replay after restart; it rides the next commit's
+fsync (a lost pop only means extra replay).
+"""
+
+from __future__ import annotations
+
+from ..core.errors import TLogStopped
+from ..core.runtime import TaskPriority, buggify, current_loop, spawn
+from ..core.serialize import BinaryReader, BinaryWriter
+from ..core.trace import TraceEvent
+from ..kv.atomic import MutationType
+from ..storage_engine.diskqueue import DiskQueue
+from .interfaces import Mutation
+from .log_system import TaggedMutation, TaggedTLog
+
+_K_ENTRY = 1
+_K_EPOCH = 2
+_K_TRUNC = 3
+_K_POP = 4
+
+
+def _enc_entry(prev_version: int, version: int, tms) -> bytes:
+    w = BinaryWriter()
+    w.u64(prev_version).u64(version).u32(len(tms))
+    for tm in tms:
+        w.u8(len(tm.tags))
+        for t in tm.tags:
+            w.u32(t)
+        w.u8(int(tm.mutation.type))
+        w.bytes_(tm.mutation.param1)
+        w.bytes_(tm.mutation.param2)
+    return w.to_bytes()
+
+
+def _dec_entry(payload: bytes):
+    r = BinaryReader(payload)
+    prev_version, version, n = r.u64(), r.u64(), r.u32()
+    tms = []
+    for _ in range(n):
+        ntags = r.u8()
+        tags = tuple(r.u32() for _ in range(ntags))
+        mtype = MutationType(r.u8())
+        p1 = r.bytes_()
+        p2 = r.bytes_()
+        tms.append(TaggedMutation(tags, Mutation(mtype, p1, p2)))
+    return prev_version, version, tms
+
+
+class DurableTaggedTLog(TaggedTLog):
+    """TaggedTLog whose durability cursor is advanced by a real fsync.
+
+    Same interface and version-chaining contract as the memory tier; the
+    only behavioral difference is that `durable` advances when the disk
+    queue's group commit covers the version (flusher actor), and lock /
+    quorum truncation are themselves made durable so a restarted log
+    resumes with the same fences.
+    """
+
+    def __init__(self, path_prefix: str, init_version: int = 0,
+                 backend: str | None = None, os_layer=None):
+        super().__init__(init_version)
+        self.queue = DiskQueue(path_prefix, backend=backend,
+                               os_layer=os_layer)
+        # version -> first queue seq of its ENTRY blob (for space pops).
+        self._entry_seq: list[tuple[int, int]] = []
+        self._flusher = None
+        # Highest version whose ENTRY is truly fsynced AND inside the last
+        # quorum truncation — the storage-flush horizon. Unlike `durable`,
+        # it is NOT advanced by lock()'s gap-skip, so a storage engine can
+        # never persist versions a mid-recovery truncation is about to
+        # discard (they are un-unwritable there).
+        self.entry_durable = init_version
+        self._recover_from_queue(init_version)
+
+    # -- record IO --
+    def _push_blob(self, kind: int, payload: bytes) -> int:
+        ch = DiskQueue.PAYLOAD_MAX - 2
+        chunks = [payload[i:i + ch] for i in range(0, len(payload), ch)]
+        if not chunks:
+            chunks = [b""]
+        first = None
+        for i, c in enumerate(chunks):
+            last = 1 if i == len(chunks) - 1 else 0
+            seq = self.queue.push(bytes((kind, last)) + c)
+            if first is None:
+                first = seq
+        return first
+
+    def _recover_from_queue(self, init_version: int) -> None:
+        entries: dict[int, list] = {}
+        cur_kind, cur_buf = None, b""
+        for _seq, data in self.queue.recovered:
+            kind, last = data[0], data[1]
+            if cur_kind is not None and kind != cur_kind:
+                cur_kind, cur_buf = None, b""  # torn blob: drop
+            cur_kind = kind
+            cur_buf += data[2:]
+            if not last:
+                continue
+            payload, cur_kind, cur_buf = cur_buf, None, b""
+            if kind == _K_ENTRY:
+                _prev, version, tms = _dec_entry(payload)
+                entries[version] = tms
+            elif kind == _K_EPOCH:
+                r = BinaryReader(payload)
+                self.locked_epoch = max(self.locked_epoch, r.u64())
+            elif kind == _K_TRUNC:
+                r = BinaryReader(payload)
+                v = r.u64()
+                entries = {k: e for k, e in entries.items() if k <= v}
+            elif kind == _K_POP:
+                r = BinaryReader(payload)
+                tag, v = r.u32(), r.u64()
+                cur = self._popped_by_tag.get(tag, 0)
+                self._popped_by_tag[tag] = max(cur, v)
+        self._entries = sorted(entries.items())
+        top = self._entries[-1][0] if self._entries else init_version
+        self.version.set(max(top, init_version))
+        self.durable.set(max(top, init_version))
+        self.entry_durable = max(top, init_version)
+        # Recovered per-tag pops guide future discards only — entries are
+        # NEVER dropped here: a hosted tag whose POP record was lost to
+        # the torn tail (or who never flushed) still needs its prefix, and
+        # the tag registry (tag_view's setdefault) fills in only after
+        # recovery. Live pop() re-discards once every registered tag
+        # catches up.
+        if self.queue.recovered:
+            TraceEvent("DurableTLogRecovered").detail(
+                "Entries", len(self._entries)
+            ).detail("Version", self.version.get()).detail(
+                "Epoch", self.locked_epoch
+            ).detail("Popped", self.popped).log()
+
+    # -- lifecycle --
+    def start(self) -> None:
+        if self._flusher is None:
+            self._flusher = spawn(self._flush_loop(),
+                                  TaskPriority.TLOG_COMMIT,
+                                  name="tlogFlusher")
+
+    def stop(self) -> None:
+        if self._flusher is not None:
+            self._flusher.cancel()
+            self._flusher = None
+
+    def close(self) -> None:
+        self.stop()
+        self.queue.close()
+
+    # -- the commit path --
+    async def commit(self, prev_version: int, version: int, mutations: list,
+                     epoch: int = 0):
+        """Identical chaining contract to MemoryTLog.commit, but the
+        durability step is a real group fsync (ref: tLogCommit waiting
+        version order, then doQueueCommit's batched sync)."""
+        self.start()  # lazily ensure the flusher runs on this loop
+        if epoch < self.locked_epoch:
+            raise TLogStopped(f"locked by generation {self.locked_epoch}")
+        await self.version.when_at_least(prev_version)
+        if epoch < self.locked_epoch:  # re-check: lock may land mid-wait
+            raise TLogStopped(f"locked by generation {self.locked_epoch}")
+        if self.version.get() == prev_version:
+            self._entries.append((version, mutations))
+            seq = self._push_blob(
+                _K_ENTRY, _enc_entry(prev_version, version, mutations)
+            )
+            self._entry_seq.append((version, seq))
+            self.version.set(version)
+        if buggify("tlog_slow_fsync"):
+            await current_loop().delay(
+                0.1 * current_loop().random.random01()
+            )
+        await self.durable.when_at_least(version)
+        # A lock() that purged this batch also advanced the durability
+        # cursor past it, waking this waiter — it must fail, not report a
+        # never-durable commit as committed.
+        if epoch < self.locked_epoch:
+            raise TLogStopped(f"locked by generation {self.locked_epoch}")
+
+    async def _flush_loop(self):
+        """Group commit: one fsync covers every batch pushed since the
+        last (ref: doQueueCommit — all waiters between syncs share one)."""
+        while True:
+            target = self.version.get()
+            if self.durable.get() >= target:
+                await self.version.when_at_least(target + 1)
+                continue
+            self.queue.commit()  # the fsync
+            self.entry_durable = max(self.entry_durable, target)
+            if target > self.durable.get():
+                self.durable.set(target)
+                TraceEvent("TLogCommitDurable").detail(
+                    "Version", target
+                ).log()
+
+    # -- fences (both made durable) --
+    def lock(self, epoch: int) -> int:
+        d = super().lock(epoch)
+        w = BinaryWriter()
+        w.u64(epoch).u64(d)
+        self._push_blob(_K_EPOCH, w.to_bytes())
+        self.queue.commit()
+        return d
+
+    def truncate_above(self, version: int) -> None:
+        super().truncate_above(version)
+        self.entry_durable = min(self.entry_durable, version)
+        w = BinaryWriter()
+        w.u64(version)
+        self._push_blob(_K_TRUNC, w.to_bytes())
+        self.queue.commit()
+
+    def quorum_durable(self) -> int:
+        return self.entry_durable
+
+    # -- pops (durable opportunistically, with queue-space release) --
+    def pop_tag(self, tag: int, upto_version: int) -> None:
+        cur = self._popped_by_tag.get(tag, 0)
+        if upto_version <= cur:
+            return
+        w = BinaryWriter()
+        w.u32(tag).u64(upto_version)
+        self._push_blob(_K_POP, w.to_bytes())  # rides the next fsync
+        super().pop_tag(tag, upto_version)
+
+    def pop(self, upto_version: int) -> None:
+        super().pop(upto_version)
+        # Release queue space: everything whose ENTRY starts before the
+        # first kept version is reclaimable (file-granular underneath).
+        keep_from = None
+        while self._entry_seq and self._entry_seq[0][0] <= upto_version:
+            keep_from = self._entry_seq.pop(0)[1]
+        if keep_from is not None:
+            self.queue.pop(keep_from)

@@ -110,12 +110,21 @@ class TLogCommitRequest:
 
 @dataclass
 class ResolveTransactionBatchRequest:
-    """(ref: ResolveTransactionBatchRequest, ResolverInterface.h:70)."""
+    """(ref: ResolveTransactionBatchRequest, ResolverInterface.h:70).
+
+    `system_mutations` carries this batch's \\xff-keyspace mutations as
+    (txn_index, Mutation) pairs for retention at resolver 0 (the
+    reference's txnStateTransactions); `committed_feedback` reports the
+    MERGED verdicts of earlier windows back to the resolver — a resolver
+    judges only its clip, so it cannot know global outcomes itself
+    (ref: Resolver.actor.cpp:171-190 state-transaction retention)."""
 
     prev_version: int
     version: int
     last_receive_version: int
     transactions: list  # list[TxnConflictInfo]
+    system_mutations: tuple = ()
+    committed_feedback: tuple = ()
     reply: Promise = field(default_factory=Promise)
 
 

@@ -75,10 +75,15 @@ class TaggedTLog(MemoryTLog):
 
 
 class TagPartitionedLogSystem:
-    def __init__(self, n_logs: int = 1, init_version: int = 0):
+    def __init__(self, n_logs: int = 1, init_version: int = 0,
+                 log_factory=None):
         assert n_logs >= 1
-        self.logs = [TaggedTLog(init_version) for _ in range(n_logs)]
-        self.locked_epoch = 0
+        if log_factory is None:
+            log_factory = lambda i: TaggedTLog(init_version)  # noqa: E731
+        self.logs = [log_factory(i) for i in range(n_logs)]
+        self.locked_epoch = max(
+            (getattr(log, "locked_epoch", 0) for log in self.logs), default=0
+        )
 
     # -- routing --
     def log_for_tag(self, tag: int) -> TaggedTLog:
@@ -124,9 +129,7 @@ class TagPartitionedLogSystem:
         # recovery version from the full quorum; the reference rolls the
         # affected storage servers back the same way).
         for log in self.logs:
-            log._entries = [
-                e for e in log._entries if e[0] <= recovery_version
-            ]
+            log.truncate_above(recovery_version)
         TraceEvent("LogSystemLocked").detail("Epoch", epoch).detail(
             "RecoveryVersion", recovery_version
         ).log()
@@ -140,7 +143,11 @@ class TagPartitionedLogSystem:
                    key=lambda nv: nv.get())
 
     def durable_version(self) -> int:
-        return min(log.durable.get() for log in self.logs)
+        # Per-log quorum_durable, NOT the raw durable cursor: the durable
+        # tier's entry_durable excludes lock()'s gap-skips, so a storage
+        # engine flushing against this horizon can never persist versions
+        # a mid-recovery quorum truncation is about to discard.
+        return min(log.quorum_durable() for log in self.logs)
 
     def queue_bytes(self) -> int:
         """Un-popped payload held across logs (ratekeeper input, ref:
@@ -179,3 +186,8 @@ class TagView:
 
     def pop(self, upto_version: int) -> None:
         self._log.pop_tag(self.tag, upto_version)
+
+    def quorum_durable(self) -> int:
+        """Durable across EVERY log in the system (the storage engine's
+        safe flush horizon — see MemoryTLog.quorum_durable)."""
+        return self.system.durable_version()

@@ -1,0 +1,916 @@
+"""Multi-process deployment: the sharded tier split across OS processes
+riding the real FlowTransport (ref: every fdbd role boundary is a
+RequestStream over FlowTransport — fdbrpc/FlowTransport.actor.cpp; the
+worker hosts a role subset per process class, worker.actor.cpp:593).
+
+Three process classes (the reference's machine-class split):
+
+    log      hosts the DurableTaggedTLogs (fsync on the commit path);
+             serves per-log commit + control (peek/pop/lock/...) endpoints
+    storage  hosts the engine-backed storage fleet; serves per-tag read +
+             control (rollback/status) endpoints; PULLS the mutation
+             stream from the log host over TCP
+    txn      hosts coordinators, the controller, and the per-generation
+             master/resolver/proxy/ratekeeper; serves the client-facing
+             GRV/commit/location endpoints (stable across recoveries via
+             EndpointRef) and a read forwarder for single-address wire
+             clients (the C client)
+
+Topology (shard boundaries, teams, tag->log routing) is DERIVED, not
+exchanged: every host computes `derive_layout` from the same deployment
+spec (the cluster file carries the spec), the reference's equivalent of
+every worker reading the same conf.
+
+Recovery is the same masterCore sequence as the in-process tiers, with
+the lock / truncate / skip / rollback steps as awaited RPCs to the log
+and storage hosts."""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..core.actors import (
+    ActorCollection,
+    PromiseStream,
+    all_of,
+    serve_requests,
+    timeout,
+)
+from ..core.errors import OperationFailed, RequestMaybeDelivered
+from ..core.knobs import SERVER_KNOBS
+from ..core.runtime import Promise, TaskPriority, current_loop, spawn
+from ..core.serialize import register_message
+from ..core.trace import TraceEvent
+from ..kv.keys import KeyRange
+from .interfaces import (
+    GetRangeRequest,
+    GetValueRequest,
+    TLogCommitRequest,
+    WatchValueRequest,
+)
+from .log_system import TaggedMutation
+
+# -- well-known tokens (extending net/service.py's client-facing trio) --
+WLTOKEN_LOCATION = 13
+WLTOKEN_LOG_BASE = 100      # +2*i commit, +2*i+1 control
+WLTOKEN_STORAGE_BASE = 300  # +2*tag read, +2*tag+1 control
+
+
+# -- wire messages for the role-to-role hops --
+@dataclass
+class TLogPeekRequest:
+    """(ref: TLogPeekRequest, TLogInterface.h — per-tag cursor pull)."""
+
+    tag: int
+    from_version: int
+    reply: Promise = field(default_factory=Promise)
+
+
+@dataclass
+class TLogPopRequest:
+    """(ref: TLogPopRequest — per-tag durability ack)."""
+
+    tag: int
+    version: int
+    reply: Promise = field(default_factory=Promise)
+
+
+@dataclass
+class TLogLockRequest:
+    """(ref: TLogLockResult gathering in epochEnd)."""
+
+    epoch: int
+    reply: Promise = field(default_factory=Promise)
+
+
+@dataclass
+class TLogTruncateRequest:
+    """Quorum truncation at epoch end (ref: epochEnd's recovery version)."""
+
+    version: int
+    reply: Promise = field(default_factory=Promise)
+
+
+@dataclass
+class TLogSkipToRequest:
+    """Recovery gap-skip (see MemoryTLog.skip_to)."""
+
+    version: int
+    reply: Promise = field(default_factory=Promise)
+
+
+@dataclass
+class TLogStatusRequest:
+    """(ref: TLogQueuingMetricsRequest — ratekeeper's log-side input)."""
+
+    reply: Promise = field(default_factory=Promise)
+
+
+@dataclass
+class StorageRollbackRequest:
+    """Epoch-end rollback (ref: storageServerRollbackRebooter)."""
+
+    version: int
+    reply: Promise = field(default_factory=Promise)
+
+
+@dataclass
+class StorageStatusRequest:
+    """(ref: StorageQueuingMetricsRequest — ratekeeper's storage input)."""
+
+    reply: Promise = field(default_factory=Promise)
+
+
+for _cls in (
+    TLogPeekRequest, TLogPopRequest, TLogLockRequest, TLogTruncateRequest,
+    TLogSkipToRequest, TLogStatusRequest, StorageRollbackRequest,
+    StorageStatusRequest, TaggedMutation,
+):
+    register_message(_cls)
+
+
+# -- cluster file: the deployment's single shared document --
+def write_cluster_file(path: str, updates: dict) -> None:
+    """Merge `updates` into the cluster file atomically. Concurrent hosts
+    merge under an advisory lock (every role host writes its own address
+    at boot), with a per-writer temp name so replaces never collide."""
+    import fcntl
+
+    lock_path = path + ".lock"
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        cur = read_cluster_file(path) or {}
+        cur.update(updates)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(cur, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+
+def read_cluster_file(path: str) -> Optional[dict]:
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        try:
+            return json.load(f)
+        except json.JSONDecodeError:
+            return None  # mid-replace read; caller retries
+
+
+def _spec_kw(spec: dict) -> dict:
+    return dict(
+        n_storage=spec.get("n_storage", 4),
+        n_logs=spec.get("n_logs", 2),
+        replication=spec.get("replication", "double"),
+        shard_boundaries=[
+            b.encode() if isinstance(b, str) else b
+            for b in spec.get("shard_boundaries", [])
+        ],
+        seed=spec.get("seed", 1),
+    )
+
+
+# ---------------------------------------------------------------------------
+# log host
+# ---------------------------------------------------------------------------
+class LogHost:
+    """Serves every tlog of the deployment (v1: one log process owns the
+    whole quorum, so system-level durability is computable locally)."""
+
+    def __init__(self, transport, datadir: str, n_logs: int):
+        from .durable_tlog import DurableTaggedTLog
+
+        os.makedirs(datadir, exist_ok=True)
+        self.logs = [
+            DurableTaggedTLog(f"{datadir}/log{i}") for i in range(n_logs)
+        ]
+        self._tasks = ActorCollection()
+        for i, log in enumerate(self.logs):
+            commit_stream: PromiseStream = PromiseStream()
+            ctrl_stream: PromiseStream = PromiseStream()
+            transport.register_endpoint(commit_stream,
+                                        WLTOKEN_LOG_BASE + 2 * i)
+            transport.register_endpoint(ctrl_stream,
+                                        WLTOKEN_LOG_BASE + 2 * i + 1)
+            self._tasks.add(serve_requests(
+                commit_stream,
+                lambda req, log=log: self._commit(log, req),
+                TaskPriority.TLOG_COMMIT, f"logCommit{i}",
+            ))
+            self._tasks.add(serve_requests(
+                ctrl_stream,
+                lambda req, log=log: self._control(log, req),
+                TaskPriority.TLOG_COMMIT, f"logCtrl{i}",
+            ))
+
+    async def _commit(self, log, req: TLogCommitRequest):
+        await log.commit(req.prev_version, req.version,
+                         list(req.mutations), epoch=req.epoch)
+        return None
+
+    async def _control(self, log, req):
+        if isinstance(req, TLogPeekRequest):
+            entries = await log.peek_tag(req.tag, req.from_version)
+            return (entries, self.durable_all())
+        if isinstance(req, TLogPopRequest):
+            log.pop_tag(req.tag, req.version)
+            return None
+        if isinstance(req, TLogLockRequest):
+            d = log.lock(req.epoch)
+            return (d, log.version.get())
+        if isinstance(req, TLogTruncateRequest):
+            log.truncate_above(req.version)
+            return None
+        if isinstance(req, TLogSkipToRequest):
+            log.skip_to(req.version)
+            return None
+        if isinstance(req, TLogStatusRequest):
+            qbytes = sum(
+                len(tm.mutation.param1) + len(tm.mutation.param2)
+                for _, tms in log._entries for tm in tms
+            )
+            return (log.version.get(), log.durable.get(), qbytes)
+        raise TypeError(f"unknown log request {type(req)}")
+
+    def durable_all(self) -> int:
+        # entry_durable, not the raw durable cursor: see
+        # TagPartitionedLogSystem.durable_version — the awaited RPC gap
+        # between lock/truncate and the storage rollbacks makes the
+        # distinction LOAD-BEARING here (a flush tick can fire inside it).
+        return min(log.quorum_durable() for log in self.logs)
+
+    def stop(self) -> None:
+        self._tasks.cancel_all()
+        for log in self.logs:
+            log.close()
+
+
+# ---------------------------------------------------------------------------
+# storage host
+# ---------------------------------------------------------------------------
+class RemoteTagView:
+    """The storage server's log handle over TCP: same duck type as
+    TagView (peek/pop/quorum_durable). The quorum-durable horizon is
+    cached from peek replies — a LOWER BOUND is always safe (the horizon
+    is monotone), so the cache never blocks a flush incorrectly far."""
+
+    def __init__(self, transport, log_addr: str, tag: int, n_logs: int):
+        self.tag = tag
+        i = tag % n_logs
+        self._ctrl = transport.remote_stream(
+            log_addr, WLTOKEN_LOG_BASE + 2 * i + 1
+        )
+        self._durable_all = 0
+
+    async def peek(self, from_version: int):
+        loop = current_loop()
+        while True:
+            req = TLogPeekRequest(self.tag, from_version)
+            self._ctrl.send(req)
+            try:
+                entries, durable_all = await req.reply.future
+            except BaseException:  # noqa: BLE001 — conn loss: re-pull
+                await loop.delay(0.2)
+                continue
+            self._durable_all = max(self._durable_all, durable_all)
+            if entries:
+                return entries
+            await loop.delay(0.05)
+
+    def pop(self, upto_version: int) -> None:
+        self._ctrl.send(TLogPopRequest(self.tag, upto_version))
+
+    def quorum_durable(self) -> int:
+        return self._durable_all
+
+
+class StorageHost:
+    def __init__(self, transport, datadir: str, spec: dict, log_addr: str):
+        from .sharded_cluster import (
+            _all_false_map,
+            _make_engine,
+            derive_layout,
+        )
+        from .storage import StorageServer
+
+        os.makedirs(datadir, exist_ok=True)
+        kw = _spec_kw(spec)
+        layout = derive_layout(kw["n_storage"], kw["replication"],
+                               kw["shard_boundaries"], kw["seed"])
+        self.storages = []
+        self._tasks = ActorCollection()
+        for tag in range(kw["n_storage"]):
+            view = RemoteTagView(transport, log_addr, tag, kw["n_logs"])
+            eng = _make_engine(spec.get("engine", "memory"),
+                               f"{datadir}/storage{tag}")
+            s = StorageServer(view, 0, tag=tag, engine=eng)
+            s.owned = _all_false_map()
+            s.assigned = _all_false_map()
+            for lo, hi, team in layout:
+                if tag in team:
+                    s.set_owned(lo, hi, True)
+                    s.set_assigned(lo, hi, True)
+            transport.register_endpoint(s.read_stream,
+                                        WLTOKEN_STORAGE_BASE + 2 * tag)
+            ctrl: PromiseStream = PromiseStream()
+            transport.register_endpoint(ctrl,
+                                        WLTOKEN_STORAGE_BASE + 2 * tag + 1)
+            self._tasks.add(serve_requests(
+                ctrl, lambda req, s=s: self._control(s, req),
+                TaskPriority.STORAGE, f"storageCtrl{tag}",
+            ))
+            s.start()
+            self.storages.append(s)
+
+    async def _control(self, s, req):
+        if isinstance(req, StorageRollbackRequest):
+            s.rollback_to(req.version)
+            return None
+        if isinstance(req, StorageStatusRequest):
+            return (s.version.get(), s.engine_durable)
+        raise TypeError(f"unknown storage request {type(req)}")
+
+    def stop(self) -> None:
+        from .sharded_cluster import close_durable_tier
+
+        self._tasks.cancel_all()
+        for s in self.storages:
+            s.stop()
+        close_durable_tier(self.storages, [])
+
+
+# ---------------------------------------------------------------------------
+# txn host
+# ---------------------------------------------------------------------------
+class RemoteLogSystem:
+    """The proxy/recovery-side view of the log quorum over TCP: push fans
+    one TLogCommitRequest per log (every log gets every version), lock /
+    truncate / skip are awaited control RPCs (ref: push :339 + epochEnd
+    :107 of TagPartitionedLogSystem, with the RPC hop made explicit)."""
+
+    def __init__(self, transport, log_addr: str, n_logs: int):
+        self.n_logs = n_logs
+        self._commit = [
+            transport.remote_stream(log_addr, WLTOKEN_LOG_BASE + 2 * i)
+            for i in range(n_logs)
+        ]
+        self._ctrl = [
+            transport.remote_stream(log_addr, WLTOKEN_LOG_BASE + 2 * i + 1)
+            for i in range(n_logs)
+        ]
+        self._durable_cache = 0
+        self._queue_bytes_cache = 0
+
+    async def push(self, prev_version: int, version: int,
+                   tagged_mutations, epoch: int = 0) -> None:
+        per_log: list[list] = [[] for _ in range(self.n_logs)]
+        for tm in tagged_mutations:
+            for i in {t % self.n_logs for t in tm.tags}:
+                per_log[i].append(tm)
+        reqs = []
+        for stream, batch in zip(self._commit, per_log):
+            req = TLogCommitRequest(prev_version, version, tuple(batch),
+                                    epoch=epoch)
+            stream.send(req)
+            reqs.append(req)
+        got = await timeout(
+            all_of([r.reply.future for r in reqs]),
+            SERVER_KNOBS.ROLE_RPC_TIMEOUT, _LOST,
+        )
+        if got is _LOST:
+            raise RequestMaybeDelivered("tlog push reply not received")
+
+    async def _control_all(self, make_req):
+        reqs = []
+        for stream in self._ctrl:
+            req = make_req()
+            stream.send(req)
+            reqs.append(req)
+        got = await timeout(
+            all_of([r.reply.future for r in reqs]),
+            SERVER_KNOBS.ROLE_RPC_TIMEOUT, _LOST,
+        )
+        if got is _LOST:
+            raise OperationFailed("log host control RPC timed out")
+        return [r.reply.future.get() for r in reqs]
+
+    async def lock(self, epoch: int) -> tuple[int, int]:
+        """Returns (recovery_version, max received version) after fencing
+        and QUORUM-TRUNCATING every log."""
+        results = await self._control_all(lambda: TLogLockRequest(epoch))
+        recovery_version = min(d for d, _v in results)
+        received = max(v for _d, v in results)
+        await self._control_all(
+            lambda: TLogTruncateRequest(recovery_version)
+        )
+        return recovery_version, received
+
+    async def skip_to(self, version: int) -> None:
+        await self._control_all(lambda: TLogSkipToRequest(version))
+
+    async def refresh_status(self) -> None:
+        results = await self._control_all(lambda: TLogStatusRequest())
+        self._durable_cache = min(d for _v, d, _q in results)
+        self._queue_bytes_cache = sum(q for _v, _d, q in results)
+
+    # Ratekeeper-facing (sync, cached by refresh_status's poller).
+    def durable_version(self) -> int:
+        return self._durable_cache
+
+    def queue_bytes(self) -> int:
+        return self._queue_bytes_cache
+
+
+_LOST = object()
+
+
+class _RemoteStorageStatus:
+    """Ratekeeper's view of one remote storage server (poller-refreshed)."""
+
+    class _V:
+        def __init__(self):
+            self.v = 0
+
+        def get(self):
+            return self.v
+
+    def __init__(self, tag: int, ctrl):
+        self.tag = tag
+        self.ctrl = ctrl
+        self.version = self._V()
+
+    async def refresh(self):
+        req = StorageStatusRequest()
+        self.ctrl.send(req)
+        got = await timeout(req.reply.future, SERVER_KNOBS.ROLE_RPC_TIMEOUT,
+                            None)
+        if got is not None:
+            self.version.v = max(self.version.v, got[0])
+
+
+class TxnHost:
+    """Coordinators + controller + the per-generation transaction system,
+    one process (ref: the cluster-controller/master machine class)."""
+
+    def __init__(self, transport, datadir: Optional[str], spec: dict,
+                 log_addr: str, storage_addr: str):
+        from .coordination import (
+            CoordinatedState,
+            CoordinatorRegister,
+            FileCoordinatorRegister,
+            LeaderElection,
+        )
+        from .recovery import EndpointRef
+        from .sharded_cluster import derive_layout
+        from .shards import ShardMap
+
+        self.transport = transport
+        kw = _spec_kw(spec)
+        self.n_logs = kw["n_logs"]
+        self.n_storage = kw["n_storage"]
+        self.log_system = RemoteLogSystem(transport, log_addr, self.n_logs)
+        self.storage_ctrl = {
+            tag: transport.remote_stream(
+                storage_addr, WLTOKEN_STORAGE_BASE + 2 * tag + 1
+            )
+            for tag in range(self.n_storage)
+        }
+        self.storage_reads = {
+            tag: transport.remote_stream(
+                storage_addr, WLTOKEN_STORAGE_BASE + 2 * tag
+            )
+            for tag in range(self.n_storage)
+        }
+        self.shard_map = ShardMap(default_team=())
+        for lo, hi, team in derive_layout(
+            self.n_storage, kw["replication"], kw["shard_boundaries"],
+            kw["seed"],
+        ):
+            self.shard_map.set_team(KeyRange(lo, hi), team)
+        if datadir is not None:
+            os.makedirs(datadir, exist_ok=True)
+            self.coordinators = [
+                FileCoordinatorRegister(f"coord{i}",
+                                        f"{datadir}/coord{i}.json")
+                for i in range(3)
+            ]
+        else:
+            self.coordinators = [
+                CoordinatorRegister(f"coord{i}") for i in range(3)
+            ]
+        self.cstate = CoordinatedState(self.coordinators, key="generation")
+        self.election = LeaderElection(
+            CoordinatedState(self.coordinators, key="leader"),
+            lease_seconds=1.0,
+        )
+        self.generation = 0
+        self.recoveries_done = 0
+        self.config_values: dict[str, str] = {}
+        self.excluded: set[int] = set()
+        self.metadata_version = 0
+        # Client-facing endpoints: stable tokens, repointed per generation.
+        self.grv_ref = EndpointRef()
+        self.commit_ref = EndpointRef()
+        self.location_ref = EndpointRef()
+        from ..net.service import WLTOKEN_COMMIT, WLTOKEN_GRV, WLTOKEN_READ
+
+        transport.register_endpoint(self.grv_ref, WLTOKEN_GRV)
+        transport.register_endpoint(self.commit_ref, WLTOKEN_COMMIT)
+        transport.register_endpoint(self.location_ref, WLTOKEN_LOCATION)
+        # Single-address wire clients (the C client) read THROUGH this
+        # host: a forwarder routes by key to the owning storage.
+        self._read_fwd: PromiseStream = PromiseStream()
+        transport.register_endpoint(self._read_fwd, WLTOKEN_READ)
+        self.master = None
+        self.resolver = None
+        self.proxy = None
+        self.ratekeeper = None
+        self._gen_tasks = ActorCollection()
+        self._controllers = ActorCollection()
+        self._tasks = ActorCollection()
+        self._tasks.add(serve_requests(
+            self._read_fwd, self._forward_read, TaskPriority.STORAGE,
+            "readForwarder",
+        ))
+
+    # -- read forwarding (by-key routing like the client's location cache) --
+    async def _forward_read(self, req):
+        if isinstance(req, GetValueRequest):
+            return await self._fwd_to_team(
+                self.shard_map.team_for_key(req.key),
+                GetValueRequest(req.key, req.version),
+            )
+        if isinstance(req, WatchValueRequest):
+            return await self._fwd_to_team(
+                self.shard_map.team_for_key(req.key),
+                WatchValueRequest(req.key, req.value, req.version),
+            )
+        if isinstance(req, GetRangeRequest):
+            # Split per shard (a storage refuses ranges crossing out of
+            # its ownership) and stitch, honoring limit/reverse — the
+            # forwarder-side analogue of the client's location-cache scan.
+            slices = self.shard_map.intersecting(
+                KeyRange(req.begin, req.end)
+            )
+            if req.reverse:
+                slices = list(reversed(slices))
+            out = []
+            for lo, hi, team in slices:
+                b = max(lo, req.begin)
+                e = req.end if hi is None else min(hi, req.end)
+                if b >= e:
+                    continue
+                left = req.limit - len(out) if req.limit else 0
+                rows = await self._fwd_to_team(
+                    team,
+                    GetRangeRequest(b, e, req.version, left, req.reverse),
+                )
+                out.extend(rows)
+                if req.limit and len(out) >= req.limit:
+                    break
+            return out
+        raise TypeError(f"unknown read request {type(req)}")
+
+    async def _fwd_to_team(self, team, fwd):
+        if not team:
+            raise OperationFailed("no team for key")
+        self.storage_reads[team[0]].send(fwd)
+        return await fwd.reply.future
+
+    def _apply_metadata(self, m, version: int = 0) -> None:
+        from .sharded_cluster import ShardedKVCluster
+
+        ShardedKVCluster._apply_metadata(self, m, version)
+
+    # -- recovery (masterCore over RPC) --
+    async def recover(self) -> None:
+        from .master import Master
+        from .proxy import CommitProxy
+        from .ratekeeper import Ratekeeper
+        from .recovery import (
+            _bump_generation,
+            _seal_generation,
+            _send_recovery_txn,
+        )
+        from .resolver_role import ResolverRole
+        from ..resolver.cpu import ConflictSetCPU
+
+        generation = _bump_generation(self.cstate)
+        recovery_version, received = await self.log_system.lock(generation)
+        # Every storage must CONFIRM its rollback before the new
+        # generation starts: an un-rolled-back replica above the quorum
+        # truncation would diverge from its team. An unreachable storage
+        # host fails THIS recovery attempt; the controller retries.
+        for tag, ctrl in self.storage_ctrl.items():
+            for attempt in range(3):
+                req = StorageRollbackRequest(recovery_version)
+                ctrl.send(req)
+                got = await timeout(
+                    req.reply.future, SERVER_KNOBS.ROLE_RPC_TIMEOUT, _LOST
+                )
+                if got is not _LOST:
+                    break
+            else:
+                raise OperationFailed(
+                    f"storage {tag} did not confirm rollback to "
+                    f"{recovery_version}"
+                )
+        start_version = max(recovery_version, received)
+        await self.log_system.skip_to(start_version)
+
+        self._gen_tasks.cancel_all()
+        if self.proxy is not None:
+            self.proxy.stop()
+        if self.ratekeeper is not None:
+            self.ratekeeper.stop()
+        self.generation = generation
+        self.master = Master(init_version=start_version)
+        self.resolver = ResolverRole(ConflictSetCPU(start_version),
+                                     init_version=start_version)
+        storage_statuses = [
+            _RemoteStorageStatus(tag, ctrl)
+            for tag, ctrl in self.storage_ctrl.items()
+        ]
+        self.ratekeeper = Ratekeeper(self.log_system, storage_statuses)
+        self.ratekeeper.set_excluded(self.excluded)
+        self.proxy = CommitProxy(
+            self.master, self.resolver, tlog=None,
+            ratekeeper=self.ratekeeper, generation=generation,
+            log_system=self.log_system, shard_map=self.shard_map,
+        )
+        self.proxy.metadata_hook = self._apply_metadata
+        self.ratekeeper.start()
+        self.proxy.start()
+        self._gen_tasks.add(spawn(
+            self._status_poller(storage_statuses), TaskPriority.DEFAULT,
+            name="statusPoller",
+        ))
+        self.grv_ref.target = self.proxy.grv_stream
+        self.commit_ref.target = self.proxy.commit_stream
+        self.location_ref.target = self.proxy.location_stream
+        _send_recovery_txn(self.commit_ref, start_version)
+        _seal_generation(self.cstate, generation, recovery_version)
+        # Discard never-durable \xff effects (same contract as
+        # RecoverableShardedCluster._rebuild_metadata_caches): clamp the
+        # watermark to a reachable version, then re-derive the caches from
+        # durable storage.
+        self.metadata_version = min(self.metadata_version, start_version)
+        self._gen_tasks.add(spawn(
+            self._rebuild_metadata_caches(start_version),
+            TaskPriority.DEFAULT, name="metadataRebuild",
+        ))
+        self.recoveries_done += 1
+        TraceEvent("RecoveryComplete").detail(
+            "Generation", generation
+        ).detail("RecoveryVersion", recovery_version).detail(
+            "MultiProcess", True
+        ).log()
+
+    async def _rebuild_metadata_caches(self, recovery_version: int) -> None:
+        from ..kv.keys import strinc
+        from .system_data import (
+            CONF_PREFIX,
+            EXCLUDED_PREFIX,
+            decode_config_key,
+            decode_excluded_server_key,
+        )
+
+        loop = current_loop()
+        generation = self.generation
+        begin, end = CONF_PREFIX, strinc(CONF_PREFIX)
+        while self.generation == generation:
+            target = max(recovery_version, self.metadata_version)
+            try:
+                rows = await self._forward_read(
+                    GetRangeRequest(begin, end, target)
+                )
+            except BaseException:  # noqa: BLE001 — storage still catching up
+                await loop.delay(0.2)
+                continue
+            if self.generation != generation:
+                return
+            if self.metadata_version > target:
+                continue  # a commit raced the read; re-derive
+            excluded: set[int] = set()
+            conf: dict[str, str] = {}
+            for k, v in rows:
+                if k.startswith(EXCLUDED_PREFIX):
+                    excluded.add(decode_excluded_server_key(k))
+                elif k.startswith(CONF_PREFIX):
+                    conf[decode_config_key(k)] = v.decode()
+            self.excluded.clear()
+            self.excluded.update(excluded)
+            self.config_values.clear()
+            self.config_values.update(conf)
+            if self.ratekeeper is not None:
+                self.ratekeeper.set_excluded(self.excluded)
+            TraceEvent("MetadataCachesRebuilt").detail(
+                "Version", target
+            ).detail("MultiProcess", True).log()
+            return
+
+    async def _status_poller(self, storage_statuses) -> None:
+        loop = current_loop()
+        while True:
+            try:
+                await self.log_system.refresh_status()
+                for st in storage_statuses:
+                    await st.refresh()
+            except BaseException:  # noqa: BLE001 — transient RPC loss
+                pass
+            await loop.delay(SERVER_KNOBS.RATEKEEPER_UPDATE_INTERVAL)
+
+    def _stop_transaction_system(self) -> None:
+        self._gen_tasks.cancel_all()
+        if self.proxy is not None:
+            self.proxy.stop()
+        if self.ratekeeper is not None:
+            self.ratekeeper.stop()
+        self.master = self.resolver = self.proxy = self.ratekeeper = None
+        self.grv_ref.target = None
+        self.commit_ref.target = None
+        self.location_ref.target = None
+
+    def start_controller(self, name: str = "cc0") -> None:
+        """Same election + health-probe + recover loop as the in-process
+        tiers (RecoverableCluster.start_controller), with the recovery
+        steps awaited over RPC."""
+        from ..core.errors import ActorCancelled
+
+        async def controller():
+            loop = current_loop()
+            lease = None
+            while True:
+                await loop.delay(
+                    SERVER_KNOBS.RATEKEEPER_UPDATE_INTERVAL
+                    * (0.8 + 0.4 * loop.random.random01())
+                )
+                try:
+                    if lease is None:
+                        lease = self.election.try_become_leader(name)
+                        continue
+                    renewed = self.election.heartbeat(lease)
+                    if renewed is None:
+                        lease = None
+                        continue
+                    lease = renewed
+                    if not await self._txn_system_healthy():
+                        TraceEvent("ControllerRecovering",
+                                   severity=30).detail("Name", name).detail(
+                            "Generation", self.generation
+                        ).log()
+                        await self.recover()
+                except ActorCancelled:
+                    raise
+                except BaseException as e:  # noqa: BLE001
+                    TraceEvent("ControllerError", severity=30).error(e).log()
+
+        self._controllers.add(
+            spawn(controller(), TaskPriority.COORDINATION,
+                  name=f"controller:{name}")
+        )
+
+    async def _txn_system_healthy(self) -> bool:
+        from .recovery import RecoverableCluster
+
+        return await RecoverableCluster._txn_system_healthy(self)
+
+    def stop(self) -> None:
+        self._controllers.cancel_all()
+        self._stop_transaction_system()
+        self._tasks.cancel_all()
+
+
+# ---------------------------------------------------------------------------
+# client side
+# ---------------------------------------------------------------------------
+def connect(transport, cluster_file: str):
+    """Build a Database against a multi-process deployment: GRV/commit/
+    location at the txn host, reads direct to the storage host by tag
+    (ref: the client's two-hop architecture — proxies for the txn path,
+    storage servers for reads)."""
+    from ..client.connection import ShardedConnection
+    from ..client.database import Database
+    from ..net.service import WLTOKEN_COMMIT, WLTOKEN_GRV
+
+    info = read_cluster_file(cluster_file)
+    if not info or "txn" not in info:
+        raise OperationFailed(f"cluster file {cluster_file} incomplete")
+    spec = info.get("spec", {})
+    n_storage = spec.get("n_storage", 4)
+    conn = ShardedConnection(
+        transport.remote_stream(info["txn"], WLTOKEN_GRV),
+        transport.remote_stream(info["txn"], WLTOKEN_COMMIT),
+        transport.remote_stream(info["txn"], WLTOKEN_LOCATION),
+        {
+            tag: transport.remote_stream(
+                info["storage"], WLTOKEN_STORAGE_BASE + 2 * tag
+            )
+            for tag in range(n_storage)
+        },
+    )
+    return Database(None, conn=conn)
+
+
+# ---------------------------------------------------------------------------
+# process entrypoints (server.py -r fdbd --class ...)
+# ---------------------------------------------------------------------------
+def run_role_host(role_class: str, cluster_file: str, datadir: str,
+                  port: int = 0, ready=None, stop_event=None) -> None:
+    """Run one role host on a real-clock loop until stop_event. The host
+    merges its listen address into the cluster file; hosts needing peers
+    wait for the peers' addresses to appear (discovery via the shared
+    file, the reference's cluster-file contract)."""
+    from ..net.transport import real_loop_with_transport
+
+    spec = None
+    while spec is None:
+        info = read_cluster_file(cluster_file)
+        spec = (info or {}).get("spec")
+        if spec is None:
+            import time as _t
+
+            _t.sleep(0.05)
+    # A pinned per-class port (spec["ports"]) keeps the address stable
+    # across process restarts, so peers' cached addresses stay valid (the
+    # reference pins fdbd listen addresses in its conf the same way).
+    port = spec.get("ports", {}).get(role_class, port)
+    loop, transport = real_loop_with_transport(port=port)
+    with _loop_ctx(loop):
+
+        def stopping() -> bool:
+            return stop_event is not None and stop_event.is_set()
+
+        async def main():
+            host = None
+            if role_class == "log":
+                host = LogHost(transport, f"{datadir}/log",
+                               spec.get("n_logs", 2))
+            elif role_class == "storage":
+                log_addr = await _wait_for(cluster_file, "log", stopping)
+                if log_addr is None:
+                    return
+                host = StorageHost(transport, f"{datadir}/storage", spec,
+                                   log_addr)
+            elif role_class == "txn":
+                log_addr = await _wait_for(cluster_file, "log", stopping)
+                storage_addr = await _wait_for(cluster_file, "storage",
+                                               stopping)
+                if log_addr is None or storage_addr is None:
+                    return
+                host = TxnHost(transport, f"{datadir}/txn", spec,
+                               log_addr, storage_addr)
+                # Peers may still be coming up (or restarting): the boot
+                # recovery retries until the log quorum answers — but a
+                # SIGTERM must still win (peers may never come up).
+                while not stopping():
+                    try:
+                        await host.recover()
+                        break
+                    except BaseException as e:  # noqa: BLE001
+                        TraceEvent("BootRecoveryRetry",
+                                   severity=30).error(e).log()
+                        await current_loop().delay(0.5)
+                host.start_controller("cc0")
+            else:
+                raise ValueError(f"unknown process class {role_class!r}")
+            # Publish the address only once the endpoints are LIVE — a
+            # peer reading the cluster file must never race this host's
+            # registration (txn publishes after its first recovery, so a
+            # client that sees "txn" can commit immediately).
+            write_cluster_file(cluster_file,
+                               {role_class: transport.local_address})
+            if ready is not None:
+                ready.address = transport.local_address
+                ready.set()
+            try:
+                while stop_event is None or not stop_event.is_set():
+                    await current_loop().delay(0.05)
+            finally:
+                host.stop()
+
+        loop.run(main())
+        transport.close()
+
+
+async def _wait_for(cluster_file: str, key: str,
+                    stopping=lambda: False) -> Optional[str]:
+    """Poll the cluster file for a peer's address; None once `stopping`."""
+    loop = current_loop()
+    while not stopping():
+        info = read_cluster_file(cluster_file)
+        if info and key in info:
+            return info[key]
+        await loop.delay(0.05)
+    return None
+
+
+def _loop_ctx(loop):
+    from ..core.runtime import loop_context
+
+    return loop_context(loop)

@@ -56,9 +56,22 @@ class CommitProxy:
     def __init__(self, master: Master, resolver: ResolverRole, tlog: MemoryTLog,
                  ratekeeper=None, generation: int = 0,
                  resolver_endpoint=None, tlog_endpoint=None,
-                 log_system=None, shard_map=None):
+                 log_system=None, shard_map=None,
+                 resolvers=None, resolver_config=None):
         self.master = master
         self.resolver = resolver
+        # Multi-resolver mode (ref: ResolutionRequestBuilder): when
+        # `resolvers` + `resolver_config` are given, phase 2 clips each
+        # txn's conflict ranges per resolver coverage and merges verdicts
+        # with max; `resolver` is then resolvers[0] (system-keyspace home).
+        self.resolvers = resolvers
+        self.resolver_config = resolver_config
+        # Per-resolver last window THIS proxy received state for (drives
+        # the catch-up payload in replies — Resolver.actor.cpp:171-190).
+        self._last_receive = 0
+        # Merged-verdict feedback owed to resolver 0 (windows resolved by
+        # this proxy whose system mutations await promotion).
+        self._feedback: list = []
         self.tlog = tlog
         self.ratekeeper = ratekeeper
         self.generation = generation
@@ -216,7 +229,8 @@ class CommitProxy:
                        severity=30 if (fenced or lost_rpc) else 40
                        ).error(e).log()
             try:
-                await self.resolver.skip_window(prev_version, version)
+                for role in (self.resolvers or [self.resolver]):
+                    await role.skip_window(prev_version, version)
                 await self._tlog_commit(prev_version, version, [])
                 self.master.report_committed(version)
             except TLogStopped:
@@ -242,6 +256,61 @@ class CommitProxy:
             for r in reqs:
                 if not r.reply.is_set():
                     r.reply.send_error(err)
+
+    async def _resolve_multi(self, prev_version, version, txns, reqs):
+        """Fan resolution across the resolver partition and merge (ref:
+        ResolutionRequestBuilder clipping per resolver,
+        MasterProxyServer.actor.cpp:233-312, + the :431-447 merge — any
+        resolver's CONFLICT/TOO_OLD wins)."""
+        import numpy as np
+
+        from ..core.actors import all_of
+        from ..core.runtime import TaskPriority, spawn as _spawn
+        from .resolution import clip_txns
+
+        sys_muts = tuple(
+            (idx, m)
+            for idx, r in enumerate(reqs)
+            for m in r.mutations
+            if m.param1.startswith(b"\xff")
+        )
+        feedback, self._feedback = tuple(self._feedback), []
+        batch_reqs = []
+        for i, role in enumerate(self.resolvers):
+            batch_reqs.append(ResolveTransactionBatchRequest(
+                prev_version=prev_version,
+                version=version,
+                last_receive_version=(
+                    self._last_receive if i == 0 else prev_version
+                ),
+                transactions=clip_txns(
+                    txns, self.resolver_config.coverage(i, version)
+                ),
+                system_mutations=sys_muts if i == 0 else (),
+                committed_feedback=feedback if i == 0 else (),
+            ))
+        tasks = [
+            _spawn(role.resolve_batch(br), TaskPriority.RESOLVER,
+                   name=f"resolve{i}")
+            for i, (role, br) in enumerate(zip(self.resolvers, batch_reqs))
+        ]
+        results = await all_of([t.done for t in tasks])
+        merged = np.zeros(len(txns), dtype=np.int64)
+        for res in results:
+            merged = np.maximum(merged, np.asarray(res.statuses))
+        from ..resolver.types import ConflictBatchResult
+
+        out = ConflictBatchResult([int(s) for s in merged])
+        # Catch-up state from resolver 0 (windows other proxies committed)
+        # is applied by the caller BEFORE this window's own metadata.
+        out.state_mutations = getattr(results[0], "state_mutations", ())
+        self._last_receive = prev_version
+        if sys_muts:
+            committed = tuple(
+                idx for idx, s in enumerate(merged) if s == COMMITTED
+            )
+            self._feedback.append((version, committed))
+        return out
 
     async def _call_endpoint(self, endpoint, req):
         """One role-to-role RPC with a deadline: a reply that never comes
@@ -359,17 +428,27 @@ class CommitProxy:
             )
             for r in reqs
         ]
-        resolve_req = ResolveTransactionBatchRequest(
-            prev_version=prev_version,
-            version=version,
-            last_receive_version=prev_version,
-            transactions=txns,
-        )
-        if self.resolver_endpoint is not None:
+        if self.resolvers is not None:
+            result = await self._resolve_multi(
+                prev_version, version, txns, reqs
+            )
+        elif self.resolver_endpoint is not None:
+            resolve_req = ResolveTransactionBatchRequest(
+                prev_version=prev_version,
+                version=version,
+                last_receive_version=prev_version,
+                transactions=txns,
+            )
             result = await self._call_endpoint(
                 self.resolver_endpoint, resolve_req
             )
         else:
+            resolve_req = ResolveTransactionBatchRequest(
+                prev_version=prev_version,
+                version=version,
+                last_receive_version=prev_version,
+                transactions=txns,
+            )
             result = await self.resolver.resolve_batch(resolve_req)
 
         # Phase 3: merge verdicts, build the log payload; interpret
@@ -383,6 +462,12 @@ class CommitProxy:
         # (RecoverableShardedCluster._rebuild_metadata_caches, the
         # txnStateStore-rebuild analogue).
         mutations = []
+        if self.metadata_hook is not None:
+            # Other proxies' committed \xff effects first (resolver-0
+            # catch-up state), in version order, then this window's own.
+            for v, ms in getattr(result, "state_mutations", ()):
+                for m in ms:
+                    self.metadata_hook(m, v)
         for r, status in zip(reqs, result.statuses):
             if status == COMMITTED:
                 mutations.extend(r.mutations)

@@ -60,6 +60,22 @@ class EndpointRef:
         # timeout/retry machinery handles it like any lost request.
 
 
+class MultiEndpoint:
+    """Round-robin over a proxy fleet's identical endpoints (ref: the
+    client spreading GRV/commit across proxies,
+    fdbclient/NativeAPI.actor.cpp getReadVersion/commit load balance)."""
+
+    def __init__(self, targets):
+        self.targets = list(targets)
+        self._i = 0
+
+    def send(self, req) -> None:
+        if not self.targets:
+            return
+        self._i = (self._i + 1) % len(self.targets)
+        self.targets[self._i].send(req)
+
+
 def _bump_generation(cstate) -> int:
     """Step 1 of every recovery: fence older generations in the
     coordinated state (shared by both recoverable tiers)."""
@@ -314,9 +330,25 @@ class RecoverableShardedCluster:
             lambda v: ConflictSetCPU(v)
         )
         self.inner = ShardedKVCluster(**sharded_kw)
-        self.coordinators = [
-            CoordinatorRegister(f"coord{i}") for i in range(n_coordinators)
-        ]
+        datadir = sharded_kw.get("datadir")
+        if datadir is not None:
+            # Durable coordinators ride the same datadir: the generation
+            # counter and its fencing promises must survive a process kill
+            # (a cold boot IS a recovery — it bumps the durable generation
+            # and fences the recovered logs with it).
+            from .coordination import FileCoordinatorRegister
+
+            self.coordinators = [
+                FileCoordinatorRegister(
+                    f"coord{i}", f"{datadir}/coord{i}.json"
+                )
+                for i in range(n_coordinators)
+            ]
+        else:
+            self.coordinators = [
+                CoordinatorRegister(f"coord{i}")
+                for i in range(n_coordinators)
+            ]
         self.cstate = CoordinatedState(self.coordinators, key="generation")
         self.election = LeaderElection(
             CoordinatedState(self.coordinators, key="leader"),
@@ -328,6 +360,10 @@ class RecoverableShardedCluster:
         self.commit_ref = EndpointRef()
         self.location_ref = EndpointRef()
         self._controllers = ActorCollection()
+        # Per-generation auxiliary tasks (metadata rebuild): cancelled on
+        # the next recovery / stop so a rebuild parked on a never-reached
+        # version can't leak.
+        self._gen_tasks = ActorCollection()
 
     # -- data-plane passthroughs (status/DD/tests address the cluster) --
     def __getattr__(self, name):
@@ -350,6 +386,11 @@ class RecoverableShardedCluster:
             self.inner.dd.stop()
         for s in self.inner.storages:
             s.stop()
+        if self.inner.datadir is not None:
+            from .sharded_cluster import close_durable_tier
+
+            close_durable_tier(self.inner.storages,
+                               self.inner.log_system.logs)
 
     def database(self):
         from ..client.connection import ShardedConnection
@@ -370,8 +411,9 @@ class RecoverableShardedCluster:
 
     def _stop_transaction_system(self) -> None:
         inner = self.inner
-        if inner.proxy is not None:
-            inner.proxy.stop()
+        self._gen_tasks.cancel_all()
+        for p in (inner.proxies or []) if inner.proxy is not None else []:
+            p.stop()
         if inner.ratekeeper is not None:
             inner.ratekeeper.stop()
         # Null the dead generation's roles: the health probe's fast path
@@ -379,7 +421,9 @@ class RecoverableShardedCluster:
         # a fenced corpse (matches RecoverableCluster's stop).
         inner.master = None
         inner.resolver = None
+        inner.resolvers = []
         inner.proxy = None
+        inner.proxies = []
         inner.ratekeeper = None
         self.grv_ref.target = None
         self.commit_ref.target = None
@@ -405,29 +449,71 @@ class RecoverableShardedCluster:
             recovery_version,
             max(log.version.get() for log in inner.log_system.logs),
         )
+        # Cold-boot alignment: recovered logs can sit at different durable
+        # tops; every chain must start at start_version or the behind logs
+        # wedge the first push (see MemoryTLog.skip_to).
+        for log in inner.log_system.logs:
+            log.skip_to(start_version)
 
         self._stop_transaction_system()
         self.generation = generation
         inner.master = Master(init_version=start_version)
-        inner.resolver = ResolverRole(
-            self.conflict_set_factory(start_version),
-            init_version=start_version,
-        )
+        # Recruit the full resolution partition + proxy fleet again (ref:
+        # masterCore recruiting proxies/resolvers per DatabaseConfiguration
+        # each generation). Boundaries persist across generations; each
+        # resolver's history re-seeds AT the recovery point.
+        if inner.resolver_config is not None:
+            inner.resolvers = [
+                ResolverRole(self.conflict_set_factory(start_version),
+                             init_version=start_version)
+                for _ in range(inner.n_resolvers)
+            ]
+            inner.resolver_config.transitions.clear()
+        else:
+            inner.resolvers = [ResolverRole(
+                self.conflict_set_factory(start_version),
+                init_version=start_version,
+            )]
+        inner.resolver = inner.resolvers[0]
         inner.ratekeeper = Ratekeeper(inner.log_system, inner.storages)
         inner.ratekeeper.set_excluded(
             inner.dd.failed if inner.dd else inner.excluded
         )
-        inner.proxy = CommitProxy(
-            inner.master, inner.resolver, tlog=None,
-            ratekeeper=inner.ratekeeper, generation=generation,
-            log_system=inner.log_system, shard_map=inner.shard_map,
-        )
-        inner.proxy.metadata_hook = inner._apply_metadata
+        inner.proxies = [
+            CommitProxy(
+                inner.master, inner.resolver, tlog=None,
+                ratekeeper=inner.ratekeeper, generation=generation,
+                log_system=inner.log_system, shard_map=inner.shard_map,
+                resolvers=(inner.resolvers
+                           if inner.resolver_config is not None else None),
+                resolver_config=inner.resolver_config,
+            )
+            for _ in range(inner.n_proxies)
+        ]
+        inner.proxy = inner.proxies[0]
+        for p in inner.proxies:
+            p.metadata_hook = inner._apply_metadata
         inner.ratekeeper.start()
-        inner.proxy.start()
-        self.grv_ref.target = inner.proxy.grv_stream
-        self.commit_ref.target = inner.proxy.commit_stream
-        self.location_ref.target = inner.proxy.location_stream
+        for p in inner.proxies:
+            p.start()
+        if inner.resolver_config is not None:
+            self._gen_tasks.add(inner._start_balancer(
+                inner.resolver_config, inner.resolvers
+            ))
+        if len(inner.proxies) > 1:
+            self.grv_ref.target = MultiEndpoint(
+                [p.grv_stream for p in inner.proxies]
+            )
+            self.commit_ref.target = MultiEndpoint(
+                [p.commit_stream for p in inner.proxies]
+            )
+            self.location_ref.target = MultiEndpoint(
+                [p.location_stream for p in inner.proxies]
+            )
+        else:
+            self.grv_ref.target = inner.proxy.grv_stream
+            self.commit_ref.target = inner.proxy.commit_stream
+            self.location_ref.target = inner.proxy.location_stream
 
         _send_recovery_txn(self.commit_ref, start_version)
         _seal_generation(self.cstate, generation, recovery_version)
@@ -453,11 +539,11 @@ class RecoverableShardedCluster:
         # will ever reach (its commit never became durable), and the
         # rebuild's read must wait only on reachable versions.
         inner.metadata_version = min(inner.metadata_version, start_version)
-        spawn(
+        self._gen_tasks.add(spawn(
             self._rebuild_metadata_caches(start_version),
             TaskPriority.DEFAULT,
             name="metadataRebuild",
-        )
+        ))
         TraceEvent("RecoveryComplete").detail("Generation", generation).detail(
             "RecoveryVersion", recovery_version
         ).detail("Sharded", True).log()
@@ -519,6 +605,10 @@ class RecoverableShardedCluster:
             inner.excluded.update(excluded)
             inner.config_values.clear()
             inner.config_values.update(conf)
+            # Ratekeeper holds a COPY of the exclusion set: re-sync it so
+            # a discarded phantom exclusion stops suppressing its input.
+            if inner.ratekeeper is not None and inner.dd is None:
+                inner.ratekeeper.set_excluded(inner.excluded)
             TraceEvent("MetadataCachesRebuilt").detail(
                 "Version", target
             ).detail("Excluded", len(excluded)).detail(

@@ -50,10 +50,62 @@ class ResolverRole:
         self.conflict_batches = 0
         self.conflict_transactions = 0
         self.total_transactions = 0
+        # Load accounting for resolutionBalancing (ref: the iopsSample
+        # fed to the master, Resolver.actor.cpp:148-152): total conflict-
+        # range keys judged, plus a reservoir of range-begin keys the
+        # balancer splits on.
+        self.keys_resolved = 0
+        self._sample: list[bytes] = []
+        self._sample_seen = 0
+        # State-transaction retention (ref: Resolver.actor.cpp:171-190):
+        # system-keyspace mutations of recent windows, kept so OTHER
+        # proxies can catch their metadata caches up from resolve replies
+        # (only resolver 0 is fed — the system keyspace's single home).
+        self._pending_state: dict[int, list] = {}   # version -> [(idx, m)]
+        self.state_store: dict[int, tuple] = {}     # version -> (Mutation,)
+
+    _SAMPLE_CAP = 64
+
+    def _sample_key(self, key: bytes) -> None:
+        from ..core.runtime import current_loop
+
+        self._sample_seen += 1
+        if len(self._sample) < self._SAMPLE_CAP:
+            self._sample.append(key)
+            return
+        j = current_loop().random.random_int(0, self._sample_seen)
+        if j < self._SAMPLE_CAP:
+            self._sample[j] = key
+
+    def key_sample(self) -> list[bytes]:
+        return list(self._sample)
+
+    def apply_feedback(self, feedback) -> None:
+        """Proxy feedback: which txns of an earlier window globally
+        committed — promote their retained system mutations (a resolver
+        judges only its clip, so the MERGED verdict must come back)."""
+        for version, committed_idxs in feedback:
+            pend = self._pending_state.pop(version, None)
+            if pend is None:
+                continue
+            keep = tuple(
+                m for idx, m in pend if idx in set(committed_idxs)
+            )
+            if keep:
+                self.state_store[version] = keep
+
+    def recent_state(self, above: int, upto: int):
+        """Retained committed system mutations in (above, upto]."""
+        return tuple(
+            (v, self.state_store[v])
+            for v in sorted(self.state_store)
+            if above < v <= upto
+        )
 
     async def resolve_batch(
         self, req: ResolveTransactionBatchRequest
     ) -> ConflictBatchResult:
+        self.apply_feedback(getattr(req, "committed_feedback", ()))
         await self.version.when_at_least(req.prev_version)
         if self.version.get() != req.prev_version:
             # This window was already driven past — e.g. the proxy timed
@@ -85,10 +137,32 @@ class ResolverRole:
             raise
         self.conflict_batches += 1
         self.total_transactions += len(req.transactions)
+        for t in req.transactions:
+            self.keys_resolved += len(t.read_ranges) + len(t.write_ranges)
+            for w in t.write_ranges:
+                self._sample_key(w.begin)
+        # Retain this window's system mutations until the proxy reports
+        # the merged verdicts (apply_feedback), then prune the write-life
+        # horizon.
+        sys_muts = getattr(req, "system_mutations", ())
+        if sys_muts:
+            self._pending_state[req.version] = list(sys_muts)
+        horizon = req.version - SERVER_KNOBS.MAX_WRITE_TRANSACTION_LIFE_VERSIONS
+        for v in [v for v in self.state_store if v < horizon]:
+            del self.state_store[v]
+        for v in [v for v in self._pending_state if v < horizon]:
+            del self._pending_state[v]
         n_conflict = sum(1 for s in result.statuses if s != 0)
         self.conflict_transactions += n_conflict
         TraceEvent("ResolverBatch").detail("Version", req.version).detail(
             "Transactions", len(req.transactions)
         ).detail("Conflicts", n_conflict).log()
         self.version.set(req.version)
+        # Catch-up payload for the requesting proxy: committed system
+        # mutations from windows it has not yet seen (in-process reply
+        # attribute; the wire tier will lift this into the reply message
+        # when proxies span processes).
+        result.state_mutations = self.recent_state(
+            req.last_receive_version, req.prev_version
+        )
         return result

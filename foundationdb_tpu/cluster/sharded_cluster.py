@@ -45,6 +45,11 @@ class ShardedKVCluster:
         shard_boundaries: Optional[Sequence[bytes]] = None,
         conflict_set=None,
         seed: int = 1,
+        datadir: Optional[str] = None,
+        engine: str = "memory",
+        n_proxies: int = 1,
+        n_resolvers: int = 1,
+        resolver_boundaries: Optional[Sequence[bytes]] = None,
     ):
         self.policy = policy_for_mode(replication)
         self.replicas = [
@@ -57,43 +62,92 @@ class ShardedKVCluster:
             )
             for i in range(n_storage)
         ]
-        self.log_system = TagPartitionedLogSystem(n_logs)
+        # Durable tier (ref: worker.actor.cpp recruiting tlog/storage over
+        # their on-disk files): with a datadir every tlog rides a DiskQueue
+        # (fsync on the commit path) and every storage server flushes into
+        # a recoverable engine — reopening the same datadir cold-boots the
+        # cluster from disk.
+        self.datadir = datadir
+        if datadir is not None:
+            import os as _os
+
+            from .durable_tlog import DurableTaggedTLog
+
+            _os.makedirs(datadir, exist_ok=True)
+            log_factory = lambda i: DurableTaggedTLog(  # noqa: E731
+                f"{datadir}/log{i}"
+            )
+            engines = [
+                _make_engine(engine, f"{datadir}/storage{i}")
+                for i in range(n_storage)
+            ]
+        else:
+            log_factory = None
+            engines = [None] * n_storage
+        self.log_system = TagPartitionedLogSystem(
+            n_logs, log_factory=log_factory
+        )
         self.storages = [
-            StorageServer(self.log_system.tag_view(i), 0, tag=i)
+            StorageServer(self.log_system.tag_view(i), 0, tag=i,
+                          engine=engines[i])
             for i in range(n_storage)
         ]
         # -- initial shard layout: boundaries split the keyspace; each
-        #    shard gets a policy-selected team (ref: initial DD teams) --
-        rand = DeterministicRandom(seed)
-        bounds = list(shard_boundaries or [])
+        #    shard gets a policy-selected team (ref: initial DD teams).
+        #    Derivation is DETERMINISTIC in (spec, seed) so independently
+        #    booted role hosts (multi-process deployment) agree on the
+        #    topology without exchanging it. --
+        layout = derive_layout(n_storage, replication, shard_boundaries,
+                               seed)
         self.shard_map = ShardMap(default_team=())
         for s in self.storages:
             s.owned = _all_false_map()
             s.assigned = _all_false_map()
-        edges = [b""] + bounds + [KEYSPACE_END]
-        for lo, hi in zip(edges, edges[1:]):
-            sel = self.policy.select_replicas(self.replicas, random=rand)
-            if sel is None:
-                raise ValueError(
-                    f"replication {replication!r} unsatisfiable with "
-                    f"{n_storage} storage servers"
-                )
-            team = tuple(sorted(int(r.id) for r in sel))
+        for lo, hi, team in layout:
             self.shard_map.set_team(KeyRange(lo, hi), team)
             for t in team:
                 self.storages[t].set_owned(lo, hi, True)
                 self.storages[t].set_assigned(lo, hi, True)
 
         self.master = Master(0)
-        self.resolver = ResolverRole(
-            conflict_set if conflict_set is not None else ConflictSetCPU(0), 0
-        )
+        # Resolution partition (ref: ResolutionRequestBuilder +
+        # resolutionBalancing): N resolvers each own a key-range slice;
+        # every proxy clips per resolver and max-merges verdicts. With
+        # n_resolvers=1 the single-resolver fast path is used unchanged.
+        self.n_proxies = n_proxies
+        self.n_resolvers = n_resolvers
+        self.resolver_config = None
+        if n_resolvers > 1:
+            from .resolution import ResolverConfig
+
+            bounds = list(resolver_boundaries or [
+                bytes([(256 * i) // n_resolvers])
+                for i in range(1, n_resolvers)
+            ])
+            self.resolver_config = ResolverConfig(bounds)
+            self.resolvers = [
+                ResolverRole(ConflictSetCPU(0), 0)
+                for _ in range(n_resolvers)
+            ]
+        else:
+            self.resolvers = [ResolverRole(
+                conflict_set if conflict_set is not None
+                else ConflictSetCPU(0),
+                0,
+            )]
+        self.resolver = self.resolvers[0]
         self.ratekeeper = Ratekeeper(self.log_system, self.storages)
-        self.proxy = CommitProxy(
-            self.master, self.resolver, tlog=None,
-            ratekeeper=self.ratekeeper,
-            log_system=self.log_system, shard_map=self.shard_map,
-        )
+        self.proxies = [
+            CommitProxy(
+                self.master, self.resolver, tlog=None,
+                ratekeeper=self.ratekeeper,
+                log_system=self.log_system, shard_map=self.shard_map,
+                resolvers=self.resolvers if n_resolvers > 1 else None,
+                resolver_config=self.resolver_config,
+            )
+            for _ in range(n_proxies)
+        ]
+        self.proxy = self.proxies[0]
         # Replicated cluster configuration, maintained from committed \xff
         # mutations (ref: DatabaseConfiguration fed by ApplyMetadataMutation).
         self.config_values: dict[str, str] = {}
@@ -102,8 +156,10 @@ class ShardedKVCluster:
         # lets the recovery-time rebuild detect (and retry over) a
         # concurrent commit racing its durable-state read.
         self.metadata_version = 0
-        self.proxy.metadata_hook = self._apply_metadata
+        for p in self.proxies:
+            p.metadata_hook = self._apply_metadata
         self.dd = None
+        self._balancer_task = None
         # One mover at a time across DD and test/ops tooling (ref:
         # moveKeysLock in \xff — cluster-wide by definition).
         from .data_distribution import MoveKeysLock
@@ -113,12 +169,49 @@ class ShardedKVCluster:
 
     def start(self) -> "ShardedKVCluster":
         assert not self._started
+        # A REUSED datadir must come back through the recoverable tier: a
+        # standalone start would push from version 0 beneath the recovered
+        # window (the logs would silently swallow — and falsely ack — every
+        # batch), and uneven log tops need the quorum-truncation recovery
+        # only RecoverableShardedCluster runs on boot.
+        if self.datadir is not None and any(
+            log.version.get() > 0 or log.locked_epoch > 0
+            for log in self.log_system.logs
+        ):
+            raise ValueError(
+                "datadir holds recovered log state; reopen it with "
+                "RecoverableShardedCluster (cold boot re-runs the recovery "
+                "sequence there)"
+            )
         self._started = True
         for s in self.storages:
             s.start()
         self.ratekeeper.start()
-        self.proxy.start()
+        for p in self.proxies:
+            p.start()
+        if self.resolver_config is not None:
+            self._balancer_task = self._start_balancer(
+                self.resolver_config, self.resolvers
+            )
         return self
+
+    def _start_balancer(self, config, resolvers):
+        """resolutionBalancing's control loop (ref:
+        masterserver.actor.cpp:896): periodic load compare + boundary
+        move from the busiest resolver's key sample."""
+        from ..core.knobs import SERVER_KNOBS
+        from ..core.runtime import TaskPriority, current_loop, spawn
+        from .resolution import ResolutionBalancer
+
+        self.balancer = ResolutionBalancer(config, resolvers)
+
+        async def run():
+            loop = current_loop()
+            while True:
+                await loop.delay(SERVER_KNOBS.RATEKEEPER_UPDATE_INTERVAL)
+                self.balancer.step(self.master.version)
+
+        return spawn(run(), TaskPriority.DEFAULT, name="resolutionBalance")
 
     def _apply_metadata(self, m, version: int = 0) -> None:
         """(ref: applyMetadataMutations — interpret committed \\xff writes
@@ -164,20 +257,33 @@ class ShardedKVCluster:
     def stop(self) -> None:
         if self.dd is not None:
             self.dd.stop()
-        self.proxy.stop()
+        if self._balancer_task is not None:
+            self._balancer_task.cancel()
+        for p in self.proxies:
+            p.stop()
         self.ratekeeper.stop()
         for s in self.storages:
             s.stop()
+        if self.datadir is not None:
+            close_durable_tier(self.storages, self.log_system.logs)
         self._started = False
 
     def database(self):
         from ..client.connection import ShardedConnection
         from ..client.database import Database
 
+        from .recovery import MultiEndpoint
+
+        if len(self.proxies) > 1:
+            grv = MultiEndpoint([p.grv_stream for p in self.proxies])
+            commit = MultiEndpoint([p.commit_stream for p in self.proxies])
+            loc = MultiEndpoint([p.location_stream for p in self.proxies])
+        else:
+            grv = self.proxy.grv_stream
+            commit = self.proxy.commit_stream
+            loc = self.proxy.location_stream
         conn = ShardedConnection(
-            self.proxy.grv_stream,
-            self.proxy.commit_stream,
-            self.proxy.location_stream,
+            grv, commit, loc,
             {s.tag: s.read_stream for s in self.storages},
         )
         return Database(self, conn=conn)
@@ -193,6 +299,16 @@ class ShardedKVCluster:
         # New members need the data: copy the range at the current applied
         # version from an old member (MoveKeys' fetchKeys equivalent is
         # asynchronous; tests use this synchronous stand-in).
+        if self.datadir is not None:
+            from ..core.trace import TraceEvent
+
+            # Topology changes are not yet crash-persistent: cold boot
+            # re-derives the INITIAL layout (see the keyServers follow-up
+            # in multiprocess docstring); flag loudly rather than lose
+            # moved data silently.
+            TraceEvent("ShardMoveNotDurable", severity=30).detail(
+                "Range", repr((r.begin, r.end))
+            ).log()
         donor = self.storages[next(iter(old_teams))[0]]
         rows = donor.data.get_range(r.begin, r.end, donor.version.get())
         for t in new_team:
@@ -200,6 +316,7 @@ class ShardedKVCluster:
             if t not in {m for team in old_teams for m in team}:
                 for k, v in rows:
                     s.data.set(k, v, s.version.get())
+                    s._log_durable_set(k, v, s.version.get())
             s.set_owned(r.begin, r.end, True)
             s.set_assigned(r.begin, r.end, True)
         for team in old_teams:
@@ -208,6 +325,68 @@ class ShardedKVCluster:
                     self.storages[t].set_owned(r.begin, r.end, False)
                     self.storages[t].set_assigned(r.begin, r.end, False)
         self.shard_map.set_team(r, new_team)
+
+
+def close_durable_tier(storages, logs) -> None:
+    """Final engine flush + file release for an engine-backed fleet —
+    the single shutdown sequence shared by every tier's stop path (clean
+    shutdown shortens the next boot; it is never required for
+    correctness, which rides the tlog fsync alone)."""
+    for s in storages:
+        if s.engine is not None:
+            s._flush_once()
+            s.engine.close()
+    for log in logs:
+        log.close()
+
+
+def derive_layout(
+    n_storage: int,
+    replication: str = "double",
+    shard_boundaries: Optional[Sequence[bytes]] = None,
+    seed: int = 1,
+) -> list[tuple[bytes, bytes, tuple]]:
+    """The initial (lo, hi, team) assignment for every shard — a pure
+    function of the deployment spec, shared by the in-process cluster and
+    the multi-process role hosts (each host derives the same topology
+    independently)."""
+    policy = policy_for_mode(replication)
+    replicas = [
+        Replica(
+            str(i),
+            LocalityData(
+                processid=f"p{i}", zoneid=f"z{i}", machineid=f"m{i}",
+                dcid=f"dc{i % 3}", data_hall=f"h{i % 3}",
+            ),
+        )
+        for i in range(n_storage)
+    ]
+    rand = DeterministicRandom(seed)
+    edges = [b""] + list(shard_boundaries or []) + [KEYSPACE_END]
+    out = []
+    for lo, hi in zip(edges, edges[1:]):
+        sel = policy.select_replicas(replicas, random=rand)
+        if sel is None:
+            raise ValueError(
+                f"replication {replication!r} unsatisfiable with "
+                f"{n_storage} storage servers"
+            )
+        out.append((lo, hi, tuple(sorted(int(r.id) for r in sel))))
+    return out
+
+
+def _make_engine(kind: str, path: str):
+    """IKeyValueStore selection (ref: the ssd/memory storeType knob,
+    worker.actor.cpp openKVStore)."""
+    if kind == "memory":
+        from ..storage_engine.memory_engine import KeyValueStoreMemory
+
+        return KeyValueStoreMemory(path)
+    if kind == "ssd":
+        from ..storage_engine.ssd_engine import KeyValueStoreSSD
+
+        return KeyValueStoreSSD(path + ".btree")
+    raise ValueError(f"unknown storage engine {kind!r}")
 
 
 def _all_false_map():

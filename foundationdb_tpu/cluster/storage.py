@@ -24,12 +24,25 @@ from .interfaces import GetRangeRequest, GetValueRequest, Mutation, WatchValueRe
 from .tlog import MemoryTLog
 
 
+_DURABLE_VERSION_KEY = b"\xff\xff/storage/durableVersion"
+
+
 class StorageServer:
     def __init__(self, tlog: MemoryTLog, init_version: int = 0,
-                 tag: int | None = None):
+                 tag: int | None = None, engine=None):
         self.tlog = tlog
         self.tag = tag  # this server's log tag (None = untagged/solo)
         self.data = VersionedMap()
+        # Durable tier (ref: updateStorage :2536 writing the oldest MVCC
+        # versions into the IKeyValueStore + restoreDurableState :2765 on
+        # boot). `engine` is any IKeyValueStore-shaped store (memory/ssd);
+        # applied mutations are captured in a flush log and written to it
+        # up to the log system's QUORUM-durable horizon, which a recovery
+        # can never roll back (the recovery version is the quorum minimum
+        # and monotone) — so disk state never needs un-writing.
+        self.engine = engine
+        self.engine_durable = init_version
+        self._flush_log: list = []  # (version, "s", key, value)|( , "c", b, e)
         self.version = NotifiedVersion(init_version)  # applied through here
         self.oldest_version = init_version
         self._watches: list[WatchValueRequest] = []
@@ -64,6 +77,8 @@ class StorageServer:
         # getKeyValues, watchValue request streams served by one role).
         self.read_stream: PromiseStream = PromiseStream()
         self._tasks = []
+        if engine is not None:
+            self._restore_durable_state()
 
     def start(self) -> None:
         from ..core.actors import serve_requests
@@ -74,10 +89,85 @@ class StorageServer:
             serve_requests(self.read_stream, self._serve_one,
                            TaskPriority.STORAGE, "storage_serve"),
         ]
+        if self.engine is not None:
+            self._tasks.append(
+                spawn(self._flush_loop(), TaskPriority.STORAGE,
+                      name="storage_flush")
+            )
 
     def stop(self) -> None:
         for t in self._tasks:
             t.cancel()
+        self._tasks = []
+
+    # -- durable tier (ref: updateStorage :2536 / restoreDurableState) --
+    def _restore_durable_state(self) -> None:
+        """Boot: rebuild the MVCC base from the engine's recovered state at
+        its recorded durable version (ref: restoreDurableState :2765)."""
+        raw = self.engine.get(_DURABLE_VERSION_KEY)
+        if raw is None:
+            return
+        dv = int(raw)
+        n = 0
+        for k, v in self.engine.get_range(b"", b"\xff\xff"):
+            self.data.set_snapshot(k, v, dv)
+            self.metrics.on_set(k, v)
+            n += 1
+        self.engine_durable = dv
+        if dv > self.version.get():
+            self.version.set(dv)
+        self.oldest_version = max(self.oldest_version, dv)
+        TraceEvent("StorageDurableRestored").detail("Tag", self.tag).detail(
+            "Version", dv
+        ).detail("Rows", n).log()
+
+    def _log_durable_set(self, key: bytes, value: bytes, version: int):
+        if self.engine is not None:
+            self._flush_log.append((version, "s", key, value))
+
+    def _log_durable_clear(self, begin: bytes, end: bytes, version: int):
+        if self.engine is not None:
+            self._flush_log.append((version, "c", begin, end))
+
+    def _flush_once(self) -> int:
+        """Write every captured effect at versions <= the quorum-durable
+        horizon into the engine, fsync, record the new durable version.
+        Returns the horizon it reached."""
+        horizon = min(self.version.get(), self.tlog.quorum_durable())
+        if horizon <= self.engine_durable:
+            return self.engine_durable
+        # Select by VERSION, not position: the flush log is apply-ordered,
+        # and end_fetch appends fetched-snapshot rows at their (older)
+        # fence version after newer live-stream entries — a prefix split
+        # would advance the durable version past unflushed fetch rows and
+        # lose them on restore. The stable sort preserves apply order
+        # within a version.
+        batch = sorted(
+            (e for e in self._flush_log if e[0] <= horizon),
+            key=lambda e: e[0],
+        )
+        self._flush_log = [e for e in self._flush_log if e[0] > horizon]
+        for _v, op, a, b in batch:
+            if op == "s":
+                self.engine.set(a, b)
+            else:
+                self.engine.clear_range(a, b)
+        self.engine.set(_DURABLE_VERSION_KEY, str(horizon).encode())
+        self.engine.commit()  # the fsync
+        self.engine_durable = horizon
+        return horizon
+
+    async def _flush_loop(self):
+        loop = current_loop()
+        while True:
+            await loop.delay(SERVER_KNOBS.STORAGE_COMMIT_INTERVAL)
+            before = self.engine_durable
+            horizon = self._flush_once()
+            if horizon > before:
+                self.tlog.pop(horizon)
+                TraceEvent("StorageDurable").detail("Tag", self.tag).detail(
+                    "Version", horizon
+                ).log()
 
     # -- request serving: each request answered via its reply promise so the
     #    endpoint works identically in-process and across the sim network --
@@ -117,7 +207,11 @@ class StorageServer:
             if new_oldest > self.oldest_version:
                 self.oldest_version = new_oldest
                 self.data.forget_before(new_oldest)
-            self.tlog.pop(self.version.get())
+            # With an engine, the log may discard only what the ENGINE has
+            # made durable (the flush loop pops); without one, applied =
+            # done, the memory tier's contract.
+            if self.engine is None:
+                self.tlog.pop(self.version.get())
 
     def rollback_to(self, version: int) -> None:
         """Epoch-end rollback: discard applied state above `version` (ref:
@@ -129,6 +223,19 @@ class StorageServer:
         self._rollback_epoch += 1
         self.data.rollback_above(version)
         self.version.rollback_to(version)
+        # The durable tier flushes only up to the QUORUM durable horizon,
+        # which the recovery version can never undercut — so a rollback
+        # below engine_durable indicates a broken invariant, not a state
+        # this server can repair (the reference reboots + refetches there).
+        if self.engine is not None:
+            if version < self.engine_durable:  # pragma: no cover
+                TraceEvent("StorageRollbackBelowDurable",
+                           severity=40).detail("Tag", self.tag).detail(
+                    "Version", version
+                ).detail("Durable", self.engine_durable).log()
+            self._flush_log = [
+                e for e in self._flush_log if e[0] <= version
+            ]
         TraceEvent("StorageRollback", severity=30).detail(
             "Tag", self.tag
         ).detail("Version", version).log()
@@ -148,6 +255,7 @@ class StorageServer:
             raise ValueError(f"no active fetch for {r!r}")
         for k, v in rows:
             self.data.set_snapshot(k, v, fence_version)
+            self._log_durable_set(k, v, fence_version)
             self.metrics.on_set(k, v)
         for version, m in buffered:
             if version > fence_version:
@@ -198,6 +306,7 @@ class StorageServer:
                     segs = nxt
                 for sb, se in segs:
                     self.data.clear_range(sb, se, version)
+                    self._log_durable_clear(sb, se, version)
                     self.metrics.on_clear_range(sb, se)
             return
         if not self.assigned[m.param1]:
@@ -208,15 +317,20 @@ class StorageServer:
             return
         if m.type == MutationType.SET_VALUE:
             self.data.set(m.param1, m.param2, version)
+            self._log_durable_set(m.param1, m.param2, version)
             self.metrics.on_set(m.param1, m.param2)
         else:
             old = self.data.get(m.param1, version)
             new = apply_atomic(m.type, old, m.param2)
             if new is None:
                 self.data.clear(m.param1, version)
+                self._log_durable_clear(
+                    m.param1, key_after(m.param1), version
+                )
                 self.metrics.on_clear_key(m.param1)
             else:
                 self.data.set(m.param1, new, version)
+                self._log_durable_set(m.param1, new, version)
                 self.metrics.on_set(m.param1, new)
 
     def _trigger_watches(self, version: int) -> None:

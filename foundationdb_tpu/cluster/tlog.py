@@ -120,3 +120,31 @@ class MemoryTLog:
             return
         self.popped = upto_version
         self._entries = [e for e in self._entries if e[0] > upto_version]
+
+    def skip_to(self, version: int) -> None:
+        """Recovery gap-skip: advance the (received, durable) cursors to
+        the new generation's start version without any entries. Needed on
+        cold boot, where logs recover to DIFFERENT durable tops (one log
+        fsynced a batch its peer hadn't when the process died): the behind
+        log would otherwise block the new chain's when_at_least forever.
+        Storage follows the entry stream, so the skipped window is
+        invisible to it (same contract as lock()'s purge gap)."""
+        if version > self.version.get():
+            self.version.set(version)
+        if version > self.durable.get():
+            self.durable.set(version)
+
+    def truncate_above(self, version: int) -> None:
+        """Epoch-end quorum truncation: discard entries above the recovery
+        version the full log quorum agreed on (ref: epochEnd — a commit
+        durable on a subset of logs never completed). The durable tier
+        overrides this to persist the truncation."""
+        self._entries = [e for e in self._entries if e[0] <= version]
+
+    def quorum_durable(self) -> int:
+        """The version durable across the WHOLE log quorum this log is part
+        of — for a solo log, its own cursor. Storage engines flush only up
+        to this horizon: anything beneath it can never be rolled back by a
+        recovery (the recovery version is the quorum minimum, and it is
+        monotone), so disk state never needs un-writing."""
+        return self.durable.get()

@@ -42,9 +42,8 @@ class DRAgent:
         differential-mode transitions)."""
         # 1) Subscribe the tag so everything after the fence is shipped.
         self._view = self.source.log_system.tag_view(self.dr_tag)
-        self.source.proxy.dr_tags = (
-            tuple(self.source.proxy.dr_tags) + (self.dr_tag,)
-        )
+        for p in getattr(self.source, "proxies", None) or [self.source.proxy]:
+            p.dr_tags = tuple(p.dr_tags) + (self.dr_tag,)
         # 2) Fence: a no-op commit; everything <= fence comes via the
         #    snapshot, everything above via the tag stream.
         from .cluster.data_distribution import _commit_fence
@@ -85,9 +84,8 @@ class DRAgent:
     def stop(self) -> None:
         if self._task is not None:
             self._task.cancel()
-        self.source.proxy.dr_tags = tuple(
-            t for t in self.source.proxy.dr_tags if t != self.dr_tag
-        )
+        for p in getattr(self.source, "proxies", None) or [self.source.proxy]:
+            p.dr_tags = tuple(t for t in p.dr_tags if t != self.dr_tag)
 
     async def _tail(self) -> None:
         while True:

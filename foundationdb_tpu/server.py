@@ -97,6 +97,31 @@ def run_fdbd(sharded: bool) -> int:
     return 0
 
 
+def run_role_host(args) -> int:
+    """One multi-process role host (ref: fdbserver -c <machine class>):
+    serves its role class over TCP, discovering peers via the cluster
+    file, until SIGTERM/SIGINT."""
+    import signal
+    import threading
+
+    from .cluster.multiprocess import run_role_host as _run
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    ready = threading.Event()
+
+    def announce():
+        ready.wait()
+        print(f"fdbtpu[{args.process_class}]: serving at {ready.address}",
+              file=sys.stderr, flush=True)
+
+    threading.Thread(target=announce, daemon=True).start()
+    _run(args.process_class, args.cluster_file, args.datadir,
+         ready=ready, stop_event=stop)
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="foundationdb_tpu.server")
     ap.add_argument("-r", "--role", default="fdbd",
@@ -104,6 +129,13 @@ def main(argv=None) -> int:
     ap.add_argument("-f", "--testfile", help="spec file for -r simulation")
     ap.add_argument("--sharded", action="store_true",
                     help="fdbd: start the sharded/replicated tier")
+    ap.add_argument("-c", "--class", dest="process_class",
+                    choices=["log", "storage", "txn"],
+                    help="fdbd: host ONE role class of a multi-process "
+                         "cluster (requires --cluster-file and --datadir)")
+    ap.add_argument("-C", "--cluster-file",
+                    help="shared cluster file (multi-process discovery)")
+    ap.add_argument("-d", "--datadir", help="data directory (durable tier)")
     ap.add_argument("--knob", action="append", default=[],
                     metavar="NAME=VALUE", help="set a knob (repeatable)")
     args = ap.parse_args(argv)
@@ -118,6 +150,10 @@ def main(argv=None) -> int:
 
         cli_main()
         return 0
+    if args.process_class:
+        if not args.cluster_file or not args.datadir:
+            ap.error("--class requires --cluster-file and --datadir")
+        return run_role_host(args)
     return run_fdbd(args.sharded)
 
 

@@ -102,12 +102,16 @@ class _AttritionWorkload:
 
 
 async def _run_workloads(cluster, db, spec) -> dict[str, Any]:
+    from .conflict_range import ConflictRangeWorkload
     from .consistency_check import ConsistencyCheckWorkload
     from .cycle import CycleWorkload
+    from .fuzz_api import FuzzApiWorkload
+    from .perf import QueuePushWorkload, ThroughputWorkload
     from .random_move_keys import RandomMoveKeysWorkload
     from .read_write import ReadWriteWorkload
     from .serializability import SerializabilityWorkload
     from .watches import WatchesWorkload
+    from .write_during_read import WriteDuringReadWorkload
 
     results: dict[str, Any] = {}
     starters = []   # (name, coroutine-future) start phases to await
@@ -177,6 +181,52 @@ async def _run_workloads(cluster, db, spec) -> dict[str, Any]:
             stoppers.append((wl.stop, wl.wait_stopped))
             checkers.append((rkey, wl.check,
                              lambda wl=wl: {"kills": wl.kills_done}))
+        elif name == "ConflictRange":
+            wl = ConflictRangeWorkload(db, key_space=w.get("key_space", 48))
+            starters.append((rkey, spawn(wl.run(
+                waves=w.get("waves", 12),
+                wave_size=w.get("wave_size", 6),
+            )).done))
+            checkers.append((rkey, wl.check,
+                             lambda wl=wl: {"txns": wl.txns_done,
+                                            "conflicts": wl.conflicts_seen,
+                                            "failures": wl.failures[:3]}))
+        elif name == "WriteDuringRead":
+            wl = WriteDuringReadWorkload(
+                db, key_space=w.get("key_space", 30)
+            )
+            starters.append((rkey, spawn(wl.run(
+                txns=w.get("txns", 30),
+                ops_per_txn=w.get("ops", 12),
+            )).done))
+            checkers.append((rkey, wl.check,
+                             lambda wl=wl: {"ops": wl.ops_done,
+                                            "txns": wl.txns_done,
+                                            "failures": wl.failures[:3]}))
+        elif name == "FuzzApi":
+            wl = FuzzApiWorkload(db)
+            starters.append((rkey, spawn(wl.run(
+                rounds=w.get("rounds", 3),
+            )).done))
+            checkers.append((rkey, wl.check,
+                             lambda wl=wl: {"probes": wl.probes_done,
+                                            "failures": wl.failures[:3]}))
+        elif name == "Throughput":
+            wl = ThroughputWorkload(db, key_space=w.get("key_space", 400))
+            starters.append((rkey, spawn(wl.run(
+                clients=w.get("clients", 8),
+                duration=w.get("duration", 3.0),
+            )).done))
+            checkers.append((rkey, None, wl.metrics))
+        elif name == "QueuePush":
+            wl = QueuePushWorkload(
+                db, value_bytes=w.get("value_bytes", 512)
+            )
+            starters.append((rkey, spawn(wl.run(
+                clients=w.get("clients", 4),
+                duration=w.get("duration", 3.0),
+            )).done))
+            checkers.append((rkey, None, wl.metrics))
         elif name == "DataDistribution":
             dd = cluster.start_data_distribution(
                 interval=w.get("interval", 0.2)

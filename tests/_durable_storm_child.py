@@ -1,0 +1,45 @@
+"""Child process for the kill -9 durability test: open a durable cluster
+on the given datadir and commit a storm of keys forever, printing
+"ACK <i>" after each commit acknowledgment. The parent kills this process
+with SIGKILL mid-storm and then verifies every acked key survived."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from foundationdb_tpu.core import loop_context  # noqa: E402
+from foundationdb_tpu.core.runtime import sim_loop  # noqa: E402
+
+
+def main() -> None:
+    datadir, seed = sys.argv[1], int(sys.argv[2])
+
+    async def storm():
+        from foundationdb_tpu.cluster.recovery import (
+            RecoverableShardedCluster,
+        )
+
+        c = RecoverableShardedCluster(
+            n_storage=4, n_logs=2, replication="double",
+            shard_boundaries=[b"m"], datadir=datadir,
+        ).start()
+        db = c.database()
+        sys.stdout.write("READY\n")
+        sys.stdout.flush()
+        i = 0
+        while True:
+            await db.set(b"s%06d" % i, b"v%d" % i)
+            # Printed strictly AFTER the commit ack: every line the parent
+            # reads is a durability promise.
+            sys.stdout.write("ACK %d\n" % i)
+            sys.stdout.flush()
+            i += 1
+
+    loop = sim_loop(seed=seed)
+    with loop_context(loop):
+        loop.run(storm(), timeout_sim_seconds=1e9)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,181 @@
+"""Multi-resolver + multi-proxy transaction system (ref:
+ResolutionRequestBuilder splitting conflict ranges per resolver,
+MasterProxyServer.actor.cpp:233-312; verdict merge :431-447; state-txn
+retention Resolver.actor.cpp:171-190; resolutionBalancing
+masterserver.actor.cpp:896)."""
+
+import pytest
+
+from foundationdb_tpu.core import delay
+
+
+def _mk(sim, **kw):
+    from foundationdb_tpu.cluster.sharded_cluster import ShardedKVCluster
+
+    kw.setdefault("n_storage", 4)
+    kw.setdefault("n_logs", 2)
+    kw.setdefault("replication", "double")
+    kw.setdefault("shard_boundaries", [b"m"])
+    kw.setdefault("n_proxies", 2)
+    kw.setdefault("n_resolvers", 4)
+    return ShardedKVCluster(**kw)
+
+
+def test_cycle_and_conflicts_across_resolver_boundaries(sim):
+    """Conflict detection must be exact when a txn's ranges span several
+    resolvers: the Cycle invariant (disjoint single-key txns) plus
+    explicit cross-boundary conflict pairs."""
+
+    async def main():
+        from foundationdb_tpu.core.errors import NotCommitted
+        from foundationdb_tpu.workloads.cycle import CycleWorkload
+
+        c = _mk(sim).start()
+        db = c.database()
+        w = CycleWorkload(db, nodes=20)
+        await w.setup()
+        await w.start(clients=4, txns_per_client=20)
+        assert await w.check()
+
+        # Cross-boundary conflict: resolver boundaries default to
+        # [0x40, 0x80, 0xc0]; a range read spanning 0x80 vs a write at
+        # 0x81 must conflict even though they land on different shards
+        # of the resolution partition.
+        await db.set(b"\x7f/k", b"a")
+        await db.set(b"\x81/k", b"b")
+        tr1 = db.create_transaction()
+        await tr1.get_range(b"\x7f", b"\x82")  # spans two resolvers
+        tr2 = db.create_transaction()
+        tr2.set(b"\x81/k", b"c")
+        await tr2.commit()
+        tr1.set(b"outcome", b"should-not-commit")
+        with pytest.raises(NotCommitted):
+            await tr1.commit()
+        assert await db.get(b"outcome") is None
+        c.stop()
+
+    sim.run(main())
+
+
+def test_state_txn_retention_feeds_resolver_zero(sim):
+    """\\xff mutations are retained at resolver 0 and promoted once the
+    proxy feeds back merged verdicts; replies to later windows carry the
+    catch-up payload (Resolver.actor.cpp:171-190)."""
+
+    async def main():
+        from foundationdb_tpu.cluster.management import exclude_servers
+
+        c = _mk(sim).start()
+        db = c.database()
+        await exclude_servers(db, [2])
+        assert c.excluded == {2}
+        # Later commits deliver the feedback for the exclusion window
+        # (it piggybacks on the SAME proxy's next batch; commits round-
+        # robin across the proxy fleet, so send several).
+        for i in range(6):
+            await db.set(b"tick%d" % i, b"t")
+        await delay(0.1)
+        r0 = c.resolvers[0]
+        assert any(
+            any(m.param1.startswith(b"\xff") for m in ms)
+            for ms in r0.state_store.values()
+        ), "committed system mutations not retained at resolver 0"
+        c.stop()
+
+    sim.run(main())
+
+
+def test_resolution_balancing_moves_hot_boundary(sim):
+    """A hot key range concentrated on one resolver triggers a boundary
+    move, and conflict detection stays exact THROUGH the transition
+    (dual routing)."""
+
+    async def main():
+        from foundationdb_tpu.core.errors import NotCommitted
+
+        c = _mk(sim, n_resolvers=2,
+                resolver_boundaries=[b"\x80"]).start()
+        db = c.database()
+        # Load: every write below 0x80 -> resolver 0 is hot.
+        for i in range(120):
+            await db.set(b"\x10hot%03d" % (i % 40), b"%d" % i)
+        for _ in range(200):
+            if c.balancer.moves:
+                break
+            await delay(0.1)
+        assert c.balancer.moves > 0, "hot boundary never moved"
+        new_b = c.resolver_config.boundaries[0]
+        assert new_b != b"\x80", "boundary unchanged despite move count"
+
+        # Conflicts must still be caught in the MOVED range while the
+        # transition dual-routes (old owner holds pre-move history).
+        await db.set(b"\x10hot000", b"base")
+        tr1 = db.create_transaction()
+        await tr1.get(b"\x10hot000")
+        tr2 = db.create_transaction()
+        tr2.set(b"\x10hot000", b"clobber")
+        await tr2.commit()
+        tr1.set(b"\x10hot-out", b"no")
+        with pytest.raises(NotCommitted):
+            await tr1.commit()
+        c.stop()
+
+    sim.run(main())
+
+
+def test_recoverable_multi_roles_under_kill(sim):
+    """The 2-proxy/4-resolver recoverable cluster: kill the transaction
+    system mid-workload; clients retry onto the recruited fleet and the
+    Cycle invariant holds (VERDICT #4's done-condition shape)."""
+
+    async def main():
+        from foundationdb_tpu.cluster.recovery import (
+            RecoverableShardedCluster,
+        )
+        from foundationdb_tpu.core.runtime import spawn
+        from foundationdb_tpu.workloads.cycle import CycleWorkload
+
+        c = RecoverableShardedCluster(
+            n_storage=4, n_logs=2, replication="double",
+            shard_boundaries=[b"m"], n_proxies=2, n_resolvers=4,
+        ).start()
+        db = c.database()
+        w = CycleWorkload(db, nodes=16)
+        await w.setup()
+
+        async def churn():
+            await w.start(clients=3, txns_per_client=30)
+
+        t = spawn(churn())
+        await delay(0.3)
+        gen0 = c.generation
+        c.kill_transaction_system()
+        c.start_controller("cc0")
+        await t.done
+        # A blocking write proves the recruited fleet serves traffic.
+        await db.set(b"post", b"alive")
+        assert c.generation > gen0
+        assert await w.check(), "cycle invariant broken across recovery"
+        assert len(c.inner.proxies) == 2
+        assert len(c.inner.resolvers) == 4
+        c.stop()
+
+    sim.run(main())
+
+
+def test_api_correctness_multi_roles(sim):
+    """ApiCorrectness (model-diffed random API usage) against the
+    multi-proxy/multi-resolver tier."""
+
+    async def main():
+        from foundationdb_tpu.workloads.api_correctness import (
+            ApiCorrectnessWorkload,
+        )
+
+        c = _mk(sim).start()
+        db = c.database()
+        w = ApiCorrectnessWorkload(db, key_space=40)
+        await w.run(200)
+        c.stop()
+
+    sim.run(main())
