@@ -42,6 +42,21 @@ import sys
 import time
 
 
+def _enable_compile_cache() -> None:
+    """Persistent XLA compilation cache: the bench must never pay tens of
+    seconds of compile on the measured path across driver runs. Must run
+    before the first computation (jax reads the config at trace time)."""
+    import jax
+
+    cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as e:  # noqa: BLE001 - older jax: cache is best-effort
+        log(f"[env] compile cache unavailable: {e!r}")
+
+
 def k8(x: int) -> bytes:
     return struct.pack(">Q", x)
 
@@ -190,7 +205,7 @@ def measure_tpu(batch_txns: int, n_batches: int, key_space: int, seed: int,
             v = version + b * version_step
             txns = gen_batch(rng, batch_txns, v, sampler)
             t_pack0 = time.perf_counter()
-            pb = pack_batch(txns, 0, cs.n_words)
+            pb = cs.pack(txns)
             batches.append((v, pb, time.perf_counter() - t_pack0))
         gen_pack_s = time.perf_counter() - t0
 
@@ -268,26 +283,40 @@ def measure_tpu(batch_txns: int, n_batches: int, key_space: int, seed: int,
     # H2D + host packing of batch i+1 overlap the kernel of batch i, like
     # the proxy pipelining successive commit batches through the resolver
     # (MasterProxyServer.actor.cpp:352-417 NotifiedVersion chain).
+    from foundationdb_tpu.resolver.tpu import collect_results
+
+    group = 2  # batches fetched per device sync (readback amortization)
+
+    def drain(record: bool) -> None:
+        # Always fetch in `group`-sized chunks (plus singles for the
+        # remainder) so the steady-state concat shape is the ONLY concat
+        # shape — a tail-sized concat would compile fresh inside the
+        # measured region.
+        while pending:
+            k = group if len(pending) >= group else 1
+            batch_h = [pending.pop(0) for _ in range(k)]
+            collect_results([h for _, h in batch_h])
+            now = time.perf_counter()
+            if record:
+                lat.extend(now - td for td, _ in batch_h)
+
     for b in range(fill + n_batches):
         v = version + b * version_step
         txns = gen_batch(rng, batch_txns, v, sampler)
-        pb = pack_batch(txns, cs.oldest_version, cs.n_words)
+        pb = cs.pack(txns)
         if b == fill:
             # Drain warm-fill work so the measured region starts clean.
-            while pending:
-                pending.pop(0)[1].result()
+            drain(record=False)
             t_pipe0 = time.perf_counter()
         t0 = time.perf_counter()
         pending.append((t0, cs.resolve_async(v, v - sw_window, pb)))
-        if len(pending) > 2:
-            td, h = pending.pop(0)
-            h.result()
+        if len(pending) > 2 + group:
+            batch_h = [pending.pop(0) for _ in range(group)]
+            collect_results([h for _, h in batch_h])
+            now = time.perf_counter()
             if b > fill:
-                lat.append(time.perf_counter() - td)
-    while pending:
-        td, h = pending.pop(0)
-        st = h.result()
-        lat.append(time.perf_counter() - td)
+                lat.extend(now - td for td, _ in batch_h)
+    drain(record=True)
     run_s = time.perf_counter() - t_pipe0
     n_resolved = n_batches * batch_txns
     lat = np.array(lat)
@@ -300,7 +329,8 @@ def measure_tpu(batch_txns: int, n_batches: int, key_space: int, seed: int,
         "history_entries": int(cs.n),
         "capacity": cs.capacity,
         "window_versions": sw_window,
-        "pipeline_depth": 3,
+        "max_in_flight": 2 + group + 1,
+        "readback_group": group,
     }
     log(f"[{name}] {results[name]['txns_per_sec']:.0f} txns/s (pipelined)  "
         f"p50 {results[name]['p50_ms_pipelined']:.1f} ms  entries {int(cs.n)}")
@@ -322,7 +352,7 @@ def measure_tpu(batch_txns: int, n_batches: int, key_space: int, seed: int,
         t0 = time.perf_counter()
         for b in range(nb + 1):
             txns = gen_batch(rng, 65536, v + b * 65536, sampler)
-            pb = pack_batch(txns, 0, cs.n_words)
+            pb = cs.pack(txns)
             t1 = time.perf_counter()
             cs.resolve_packed(v + b * 65536, 0, pb)
             if b > 0:  # batch 0 pays the compile
@@ -336,9 +366,166 @@ def measure_tpu(batch_txns: int, n_batches: int, key_space: int, seed: int,
             "history_entries": int(cs.n),
             "capacity": cs.capacity,
         }
+        bufs = [pb.buf]
+        h2d_big_ms = time_h2d(bufs) * 1e3
+        results[name]["buffer_mb"] = round(pb.buf.nbytes / 1e6, 2)
+        results[name]["h2d_ms_per_batch"] = round(h2d_big_ms, 1)
         log(f"[{name}] p50 {results[name]['p50_ms']:.1f} ms  "
             f"{results[name]['txns_per_sec']:.0f} txns/s  entries {int(cs.n)}")
+
+        # Fixed-vs-marginal decomposition -> projected real-chip numbers.
+        # The tunnel charges ~100 ms per sync and a per-dispatch floor per
+        # device op; a co-located v5e charges neither. Measure the same
+        # kernel at a small batch (same capacity => same history-scaled op
+        # shapes) to split device time into fixed (per-op floors, batch-
+        # size independent) and marginal (real compute per txn); then
+        # recombine under documented co-located assumptions.
+        n_small = 2048
+        cs2 = ConflictSetTPU(max_key_bytes=8, initial_capacity=capacity)
+        small_lat = []
+        small_pb = None
+        for b in range(5):
+            txns = gen_batch(rng, n_small, v + b * n_small, sampler)
+            small_pb = cs2.pack(txns)
+            t1 = time.perf_counter()
+            cs2.resolve_packed(v + b * n_small, 0, small_pb)
+            if b > 0:
+                small_lat.append(time.perf_counter() - t1)
+        t_small_ms = float(np.median(small_lat)) * 1e3
+        h2d_small_ms = time_h2d([small_pb.buf]) * 1e3
+        import jax
+        import jax.numpy as jnp
+        f_tiny = jax.jit(lambda s: s * 2)
+        int(f_tiny(jnp.int32(1)))
+        t0 = time.perf_counter()
+        for r in range(3):
+            int(f_tiny(jnp.int32(r)))
+        sync_ms = (time.perf_counter() - t0) / 3 * 1e3
+        dev_big = max(0.0, results[name]["p50_ms"] - h2d_big_ms - sync_ms)
+        dev_small = max(0.0, t_small_ms - h2d_small_ms - sync_ms)
+        marg_us = max(
+            0.0, (dev_big - dev_small) / (65536 - n_small) * 1e3
+        )
+        fixed_ms = max(0.0, dev_small - n_small * marg_us / 1e3)
+        # Co-located assumptions (documented, conservative): PCIe/ICI H2D
+        # 8 GB/s, sync 0.5 ms, per-op dispatch ~20x cheaper than the
+        # tunnel's per-op floor (real v5e enqueue is ~10 us/op vs the
+        # measured ~1-4 ms/op through the tunnel; 20x understates that).
+        h2d_real_ms = results[name]["buffer_mb"] / 8.0
+        proj_p50 = 65536 * marg_us / 1e3 + fixed_ms / 20.0 + h2d_real_ms + 0.5
+        results["projection_real_v5e"] = {
+            "method": "fixed/marginal split at equal capacity",
+            "batch_small": n_small,
+            "t_small_ms": round(t_small_ms, 1),
+            "device_marginal_us_per_txn": round(marg_us, 3),
+            "device_fixed_ms_tunnel": round(fixed_ms, 1),
+            "sync_ms_measured": round(sync_ms, 1),
+            "assumptions": {"h2d_gb_per_s": 8, "sync_ms": 0.5,
+                            "per_op_floor_reduction": 20},
+            "projected_p50_ms_64k": round(proj_p50, 1),
+            "projected_txns_per_sec_64k": round(65536 / proj_p50 * 1e3, 1),
+        }
+        log(f"[projection] marginal {marg_us:.2f} us/txn, fixed "
+            f"{fixed_ms:.0f} ms (tunnel) -> projected real-v5e p50@64K "
+            f"{proj_p50:.1f} ms")
     return results
+
+
+def measure_native_cpu(batch_txns: int, n_batches: int, key_space: int,
+                       seed: int):
+    """The reference-class native C++ baseline (native/conflict_set.cpp)
+    on the same workloads, fed columnar (no per-object Python work on the
+    timed path — this deliberately favors the baseline)."""
+    import numpy as np
+
+    from foundationdb_tpu.resolver.native_cpu import ConflictSetNativeCPU
+
+    nr, nw, lag = 5, 2, 100_000
+
+    def columnar(rng, n, v):
+        rkeys = rng.integers(0, key_space, n * nr).astype(">u8")
+        wkeys = rng.integers(0, key_space, n * nw).astype(">u8")
+        snaps = (v - rng.integers(0, lag, n)).astype(np.int64)
+        kb = np.zeros((n * (nr + nw), 9), np.uint8)
+        kb[:, :8] = np.concatenate([rkeys, wkeys]).view(np.uint8).reshape(-1, 8)
+        blob = np.ascontiguousarray(kb).reshape(-1)
+        offs = np.arange(n * (nr + nw), dtype=np.int64) * 9
+        r_off, w_off = offs[: n * nr], offs[n * nr:]
+        return (
+            n, snaps, np.ones(n, np.uint8), blob,
+            np.repeat(np.arange(n, dtype=np.int32), nr), r_off,
+            np.full(n * nr, 8, np.int32), r_off, np.full(n * nr, 9, np.int32),
+            np.repeat(np.arange(n, dtype=np.int32), nw), w_off,
+            np.full(n * nw, 8, np.int32), w_off, np.full(n * nw, 9, np.int32),
+        )
+
+    out = {}
+    version_step = batch_txns
+    # Uniform, window never advancing (matches the TPU uniform config).
+    rng = np.random.default_rng(seed)
+    cs = ConflictSetNativeCPU()
+    v = 1_000_000
+    lats = []
+    for b in range(n_batches):
+        args = columnar(rng, batch_txns, v + b * version_step)
+        t0 = time.perf_counter()
+        cs.resolve_columnar(v + b * version_step, 0, *args)
+        lats.append(time.perf_counter() - t0)
+    out["uniform"] = {
+        "txns_per_sec": batch_txns / float(np.median(lats)),
+        "p50_ms": float(np.percentile(lats, 50) * 1e3),
+        "history_entries": len(cs),
+    }
+    # Sliding window (GC horizon chasing the front), same scaled window as
+    # the TPU sliding-window config. The columnar caller contract requires
+    # tooOld txns' ranges to be dropped (native_cpu.resolve_columnar), so
+    # filter rows whose snapshot fell below the advancing horizon.
+    rng = np.random.default_rng(seed + 1)
+    cs = ConflictSetNativeCPU()
+    v = 10_000_000
+    fill = max(4, n_batches // 2)
+    sw_window = fill * version_step
+    lats = []
+    for b in range(fill + n_batches):
+        vv = v + b * version_step
+        (n, snaps, has_reads, blob, r_txn, r_off, rb_len, r_off2, re_len,
+         w_txn, w_off, wb_len, w_off2, we_len) = columnar(rng, batch_txns, vv)
+        live = snaps >= cs.oldest_version  # all txns have read ranges
+        keep_r = live[r_txn]
+        keep_w = live[w_txn]
+        args = (n, snaps, has_reads, blob,
+                r_txn[keep_r], r_off[keep_r], rb_len[keep_r],
+                r_off2[keep_r], re_len[keep_r],
+                w_txn[keep_w], w_off[keep_w], wb_len[keep_w],
+                w_off2[keep_w], we_len[keep_w])
+        t0 = time.perf_counter()
+        cs.resolve_columnar(vv, vv - sw_window, *args)
+        if b >= fill:
+            lats.append(time.perf_counter() - t0)
+    out["sliding_window"] = {
+        "txns_per_sec": batch_txns / float(np.median(lats)),
+        "p50_ms": float(np.percentile(lats, 50) * 1e3),
+        "history_entries": len(cs),
+    }
+    # p50 @ 64K on a fresh set (matches the TPU batch_64k config).
+    if not os.environ.get("BENCH_SKIP_64K"):
+        rng = np.random.default_rng(seed + 2)
+        cs = ConflictSetNativeCPU()
+        lats = []
+        for b in range(4):
+            args = columnar(rng, 65536, 1_000_000 + b * 65536)
+            t0 = time.perf_counter()
+            cs.resolve_columnar(1_000_000 + b * 65536, 0, *args)
+            lats.append(time.perf_counter() - t0)
+        out["batch_64k"] = {
+            "txns_per_sec": 65536 / float(np.median(lats)),
+            "p50_ms": float(np.percentile(lats, 50) * 1e3),
+            "history_entries": len(cs),
+        }
+    for k, r in out.items():
+        log(f"[native cpu {k}] {r['txns_per_sec']:.0f} txns/s  "
+            f"p50 {r['p50_ms']:.1f} ms  entries {r['history_entries']}")
+    return out
 
 
 def measure_python_oracle(batch_txns: int, key_space: int, seed: int,
@@ -387,6 +574,7 @@ def main() -> None:
 
     if args.cpu_kernel:
         os.environ["JAX_PLATFORMS"] = "cpu"
+        _enable_compile_cache()
         # Smaller sample on CPU; same shapes, so the ratio is apples/apples
         # per-txn.
         res = measure_tpu(args.batch, max(2, args.batches // 2),
@@ -397,6 +585,7 @@ def main() -> None:
 
     detail: dict = {}
     value = 0.0
+    _enable_compile_cache()
     try:
         detail["env"] = measure_env()
     except Exception as e:  # noqa: BLE001
@@ -412,6 +601,16 @@ def main() -> None:
 
     # CPU baselines for the ratio.
     cpu_best = 0.0
+    native_sliding = None
+    try:
+        native = measure_native_cpu(args.batch, args.batches, args.key_space,
+                                    args.seed)
+        detail["native_cpu"] = native
+        native_sliding = native["sliding_window"]["txns_per_sec"]
+        cpu_best = max(cpu_best, native_sliding)
+    except Exception as e:  # noqa: BLE001
+        detail["native_cpu_error"] = f"{type(e).__name__}: {e}"
+        log(f"native CPU baseline failed: {e!r}")
     try:
         hist = (detail.get("tpu", {}).get("sliding_window", {})
                 .get("history_entries") or 100_000)
@@ -447,6 +646,9 @@ def main() -> None:
         "value": round(value, 1),
         "unit": "txns/s",
         "vs_baseline": round(vs_baseline, 3),
+        "vs_native_cpu": (
+            round(value / native_sliding, 3) if native_sliding else None
+        ),
         "p50_ms_sliding_window": detail.get("tpu", {})
         .get("sliding_window", {}).get("p50_ms_pipelined"),
         "detail": detail,

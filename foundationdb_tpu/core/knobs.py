@@ -76,6 +76,10 @@ class ServerKnobs(Knobs):
         # jit-compiled shapes stays bounded (see resolver/tpu.py _chunks).
         init("TPU_MAX_CHUNK_TXNS", 65536)
         init("TPU_MAX_CHUNK_RANGES", 1 << 19)
+        # Batches per sticky-cap decay epoch (resolver shape-bucket pinning;
+        # see packing.StickyCaps): smaller = faster shrink after a traffic
+        # spike, larger = fewer recompiles.
+        init("TPU_STICKY_DECAY_BATCHES", 64)
         # Storage (ref: fdbserver/Knobs.cpp storage section)
         init("STORAGE_DURABILITY_LAG_VERSIONS", 5 * 1_000_000)
         init("STORAGE_COMMIT_INTERVAL", 0.5)

@@ -82,6 +82,66 @@ def next_bucket(x: int, minimum: int = 8) -> int:
     return m << k
 
 
+class StickyCaps:
+    """Per-batch-size high-water row caps with epoch decay.
+
+    Live row counts jitter batch to batch (clipping, too_old waves), and a
+    shape bucket chosen from each batch's own counts re-buckets almost
+    every batch — each fresh bucket is a full XLA compile ON THE COMMIT
+    PATH (measured ~2.6 s/batch on the dev pod; the round-4 bench
+    regression). Packing against the high-water bucket for the batch's
+    txn-count bucket pins the layout. To keep one anomalous range-heavy
+    batch from inflating every later H2D forever, caps decay to the
+    current epoch's max every SERVER_KNOBS.TPU_STICKY_DECAY_BATCHES
+    packs (at most one shrink recompile per epoch).
+
+    Shared by ConflictSetTPU.pack and ShardedConflictSetTPU.resolve so the
+    two paths cannot drift.
+    """
+
+    def __init__(self, decay_batches: int | None = None):
+        self._m: dict[int, list[int]] = {}  # T -> [r, w, er, ew, count]
+        self._decay = decay_batches
+
+    def _decay_batches(self) -> int:
+        if self._decay is not None:
+            return self._decay
+        from ..core.knobs import SERVER_KNOBS
+
+        return SERVER_KNOBS.TPU_STICKY_DECAY_BATCHES
+
+    def caps_for(self, n_txns: int) -> tuple[int, int, int]:
+        """(min_reads, min_writes, txn_bucket) to pass as pack_batch caps."""
+        t = next_bucket(max(n_txns, 1))
+        e = self._m.get(t)
+        return (e[0], e[1], t) if e else (0, 0, t)
+
+    def update(self, pb: "PackedBatch") -> None:
+        self.update_counts(pb.layout, pb.n_reads, pb.n_writes)
+
+    def update_counts(self, lay: "FusedLayout", n_reads: int,
+                      n_writes: int) -> None:
+        nr_b = next_bucket(max(n_reads, 1))
+        nw_b = next_bucket(max(n_writes, 1))
+        e = self._m.setdefault(lay.T, [0, 0, 0, 0, 0])
+        e[0] = max(e[0], nr_b)
+        e[1] = max(e[1], nw_b)
+        e[2] = max(e[2], nr_b)
+        e[3] = max(e[3], nw_b)
+        e[4] += 1
+        if e[4] >= self._decay_batches():
+            e[0], e[1] = e[2], e[3]
+            e[2] = e[3] = e[4] = 0
+
+    def seed(self, lay: "FusedLayout") -> None:
+        """Raise the caps to a warmed layout (ConflictSetTPU.warmup)."""
+        e = self._m.setdefault(lay.T, [0, 0, 0, 0, 0])
+        e[0] = max(e[0], lay.R)
+        e[1] = max(e[1], lay.Wr)
+        e[2] = max(e[2], lay.R)
+        e[3] = max(e[3], lay.Wr)
+
+
 def pack_keys(keys: Sequence[bytes], n_words: int) -> tuple[np.ndarray, np.ndarray]:
     """Pack keys into (N, n_words) biased-int32 big-endian words + (N,)
     int32 lengths. Fully vectorized: one concatenation + one masked scatter,

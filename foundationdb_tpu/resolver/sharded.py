@@ -139,6 +139,9 @@ class ShardedConflictSetTPU:
         self.capacity = next_pow2(initial_capacity, minimum=64)
         self.oldest_version = 0  # absolute version-offset base, all shards
         self._steps: dict = {}   # FusedLayout.key() -> jitted shard_map step
+        from .packing import StickyCaps
+
+        self._sticky = StickyCaps()
 
         from .packing import empty_state
 
@@ -176,20 +179,21 @@ class ShardedConflictSetTPU:
         a = self.axis
 
         def body(hmat, n, fused):
-            hmat_o, n_o, st, aux = _resolve_kernel_impl(
+            hmat_o, n_o, st_aux = _resolve_kernel_impl(
                 hmat[0], n[0], fused[0], lay=lay
             )
             # Proxy-side verdict merge as an ICI collective: any shard's
             # CONFLICT/TOO_OLD wins (MasterProxyServer.actor.cpp:431-447).
-            st_g = lax.pmax(st, a)
-            aux_g = lax.pmax(aux, a)
-            return hmat_o[None], n_o[None], st_g[None], aux_g[None]
+            # The trailing aux bytes: overflow (max ✓) survives the pmax;
+            # the per-shard new_n bytes do not (per-shard counts ride n_o).
+            st_g = lax.pmax(st_aux, a)
+            return hmat_o[None], n_o[None], st_g[None]
 
         step = shard_map(
             body,
             mesh=self.mesh,
             in_specs=(P(a, None, None), P(a), P(a, None)),
-            out_specs=(P(a, None, None), P(a), P(a, None), P(a, None)),
+            out_specs=(P(a, None, None), P(a), P(a, None)),
             check_rep=False,
         )
         return jax.jit(step)
@@ -257,7 +261,14 @@ class ShardedConflictSetTPU:
         flats = [flatten_batch(local, self.oldest_version) for local in per_shard]
         counts_r = [len(f[1]) for f in flats]
         counts_w = [len(f[5]) for f in flats]
-        caps = (max(counts_r), max(counts_w), len(txns))
+        # Sticky per-batch-size row caps (packing.StickyCaps, shared with
+        # ConflictSetTPU.pack): per-shard live row counts jitter (clipping
+        # + too_old waves), and re-bucketing means an XLA compile per batch
+        # on the commit path.
+        r_cap, w_cap, t_bucket = self._sticky.caps_for(len(txns))
+        caps = (
+            max(max(counts_r), r_cap), max(max(counts_w), w_cap), t_bucket
+        )
         max_writes = max(counts_w)
 
         while True:
@@ -275,6 +286,11 @@ class ShardedConflictSetTPU:
                 )
                 self._grow_width(longest)
         lay = packed[0].layout
+        # Decay/high-water bookkeeping sees the widest shard per dimension.
+        self._sticky.update_counts(
+            lay, max(p.n_reads for p in packed),
+            max(p.n_writes for p in packed),
+        )
         for pb in packed:
             pb.set_scalars(version_off, oldest_off)
         fused = self._put(
@@ -290,11 +306,10 @@ class ShardedConflictSetTPU:
         step = self._steps.get(lay.key())
         if step is None:
             step = self._steps[lay.key()] = self._build_step(lay)
-        hmat, n, st, aux = step(self.hmat, self.n, fused)
-        aux_h = np.asarray(aux)
-        if bool(aux_h[0, 1]):  # pragma: no cover - pre-growth makes this dead
+        hmat, n, st = step(self.hmat, self.n, fused)
+        st_h = np.asarray(st)[0]
+        if bool(st_h[lay.T + 4]):  # pragma: no cover - pre-growth makes this dead
             raise RuntimeError("sharded conflict set overflow despite pre-growth")
         self.hmat, self.n = hmat, n
         self.oldest_version = oldest_eff
-        statuses = np.asarray(st)[0, : len(txns)]
-        return ConflictBatchResult([int(s) for s in statuses])
+        return ConflictBatchResult([int(s) for s in st_h[: len(txns)]])

@@ -424,8 +424,18 @@ def _resolve_kernel_impl(hmat, n, fused, *, lay: FusedLayout):
         jnp.int8(TOO_OLD),
         jnp.where(conflict > 0, jnp.int8(CONFLICT), jnp.int8(COMMITTED)),
     )
-    aux = jnp.stack([new_n, overflow.astype(i32)])
-    return hmat_out, new_n, statuses, aux
+    # ONE readback array per resolve: statuses ++ new_n (4 LE bytes) ++
+    # overflow. Every host-visible result rides a single small int8 D2H —
+    # on a tunneled link each separate fetch pays the full ~100 ms round
+    # trip, so statuses and aux must not be separate arrays; and
+    # collect_results() can concat several batches' st_aux into one fetch.
+    nn_bytes = (
+        jnp.right_shift(new_n, jnp.array([0, 8, 16, 24], dtype=i32)) & 0xFF
+    ).astype(jnp.int8)
+    st_aux = jnp.concatenate(
+        [statuses, nn_bytes, overflow.astype(jnp.int8)[None]]
+    )
+    return hmat_out, new_n, st_aux
 
 
 _KERNEL_CACHE: dict = {}
@@ -444,22 +454,27 @@ def _kernel_for(lay: FusedLayout):
 
 class PendingResolve:
     """Handle to an in-flight resolve: dispatch returned without any
-    host-device sync; result() performs the (small) D2H reads and the
-    invariant checks."""
+    host-device sync; result() performs the single small D2H read and the
+    invariant checks. To amortize the per-fetch round trip over several
+    in-flight batches, use collect_results()."""
 
-    def __init__(self, cs: "ConflictSetTPU", statuses, aux, n_txns: int,
-                 seq: int, extra_snapshot: int):
+    def __init__(self, cs: "ConflictSetTPU", st_aux, n_txns: int,
+                 t_pad: int, seq: int, extra_snapshot: int):
         self._cs = cs
-        self._statuses = statuses
-        self._aux = aux
+        self._st_aux = st_aux
         self.n_txns = n_txns
+        self._t_pad = t_pad
         self._seq = seq
         self._extra_snapshot = extra_snapshot
 
     def result(self) -> np.ndarray:
-        st = np.asarray(self._statuses)[: self.n_txns]
-        aux = np.asarray(self._aux)
-        new_n, overflow = int(aux[0]), bool(aux[1])
+        return self._finish(np.asarray(self._st_aux))
+
+    def _finish(self, arr: np.ndarray) -> np.ndarray:
+        st = arr[: self.n_txns]
+        u = arr[self._t_pad : self._t_pad + 4].view(np.uint8).astype(np.uint32)
+        new_n = int(u[0] | (u[1] << 8) | (u[2] << 16) | (u[3] << 24))
+        overflow = bool(arr[self._t_pad + 4])
         if overflow:  # pragma: no cover - host pre-growth makes this dead
             # The kernel output (already installed for pipelining) silently
             # dropped entries past capacity; nothing downstream of it can be
@@ -484,6 +499,33 @@ class PendingResolve:
             cs._n_known = new_n
             cs._result_cum = self._extra_snapshot
         return st
+
+
+_CONCAT_CACHE: dict = {}
+
+
+def collect_results(handles: Sequence[PendingResolve]) -> list[np.ndarray]:
+    """Fetch several in-flight resolves with ONE device sync: a jitted
+    concat fuses the st_aux arrays on device, one D2H brings them all back.
+    On the tunneled link each separate fetch costs a full round trip
+    (~100 ms), so a pipeline draining k batches per collect pays sync/k per
+    batch instead of sync per batch."""
+    if not handles:
+        return []
+    if len(handles) == 1:
+        return [handles[0].result()]
+    shapes = tuple(int(h._st_aux.shape[0]) for h in handles)
+    fn = _CONCAT_CACHE.get(shapes)
+    if fn is None:
+        fn = _CONCAT_CACHE[shapes] = jax.jit(
+            lambda *xs: jnp.concatenate(xs)
+        )
+    flat = np.asarray(fn(*[h._st_aux for h in handles]))
+    out, at = [], 0
+    for h, n in zip(handles, shapes):
+        out.append(h._finish(flat[at : at + n]))
+        at += n
+    return out
 
 
 class ConflictSetTPU:
@@ -518,6 +560,12 @@ class ConflictSetTPU:
             empty_state(self.n_words, self.capacity, init_version)
         )
         self.n = jnp.int32(1)
+        # Sticky shape caps (see packing.StickyCaps): pins the packed
+        # layout to the per-batch-size high-water bucket so jittering live
+        # row counts cannot trigger an XLA compile per batch.
+        from .packing import StickyCaps
+
+        self._sticky = StickyCaps()
         self._n_known = 1     # last exact count read back from device
         self._cum_writes = 0  # 2*writes over ALL dispatches (monotone)
         self._result_cum = 0  # _cum_writes snapshot at last-applied result
@@ -621,12 +669,12 @@ class ConflictSetTPU:
         # buffer must not be mutated after dispatch — pack_batch allocates
         # a fresh one per batch and set_scalars runs before this line.
         out = _kernel_for(pb.layout)(self.hmat, self.n, pb.buf)
-        self.hmat, self.n, statuses, aux = out
+        self.hmat, self.n, st_aux = out
         self._cum_writes += 2 * pb.n_writes
         self._dispatch_seq += 1
         self.oldest_version = oldest_eff
         return PendingResolve(
-            self, statuses, aux, pb.n_txns, self._dispatch_seq,
+            self, st_aux, pb.n_txns, pb.layout.T, self._dispatch_seq,
             self._cum_writes,
         )
 
@@ -634,6 +682,18 @@ class ConflictSetTPU:
         self, version: int, new_oldest_version: int, pb: PackedBatch
     ) -> np.ndarray:
         return self.resolve_async(version, new_oldest_version, pb).result()
+
+    def pack(self, txns: Sequence[TxnConflictInfo]) -> PackedBatch:
+        """Pack a batch against this set's base, width and STICKY shape
+        caps (packing.StickyCaps): batches whose live row counts jitter
+        re-use the high-water compiled kernel for their batch size instead
+        of compiling a fresh bucket."""
+        pb = pack_batch(
+            txns, self.oldest_version, self.n_words,
+            caps=self._sticky.caps_for(len(txns)),
+        )
+        self._sticky.update(pb)
+        return pb
 
     def _chunks(self, txns: Sequence[TxnConflictInfo]):
         """Split a batch into chunks bounded by the knob caps (txn count and
@@ -687,7 +747,7 @@ class ConflictSetTPU:
         statuses: list[int] = []
         chunks = self._chunks(txns)
         for i, chunk in enumerate(chunks):
-            batch = pack_batch(chunk, self.oldest_version, self.n_words)
+            batch = self.pack(chunk)
             last = i == len(chunks) - 1
             st = self.resolve_packed(
                 version,
@@ -720,6 +780,9 @@ class ConflictSetTPU:
                 [], self.oldest_version, self.n_words,
                 caps=(max(r, 1), max(w, 1), max(t, 1)),
             )
+            # Seed the sticky caps so production batches of this size land
+            # on the warmed kernel instead of compiling a smaller bucket.
+            self._sticky.seed(batch.layout)
             self.resolve_packed(self.oldest_version, 0, batch)
             (self.hmat, self.n, self._n_known, self._cum_writes,
              self._result_cum, self._dispatch_seq, self._result_seq,

@@ -1,0 +1,547 @@
+// Native CPU conflict detector — the reference-class baseline the TPU
+// kernel is measured against (bench.py "vs_native_cpu").
+//
+// Semantics are exactly ConflictSetCPU (foundationdb_tpu/resolver/cpu.py),
+// i.e. the reference's versioned ConflictSet restated as a step function
+// version(x) over the key space (fdbserver/SkipList.cpp:524,979 semantics:
+// CheckMax read checks, sequential intra-batch rule, write merge at the
+// batch version, removeBefore GC). The data structure is NOT a skip list —
+// it is an original batch-oriented sorted-sweep design, chosen because for
+// the reference's real workload (large resolver batches against a large
+// resident history) cache-linear merges beat pointer-chasing:
+//
+//   1. Batch endpoints are radix-sorted by an 8-byte key prefix (stable
+//      LSD, 4x16-bit passes), then equal-prefix runs are refined by full
+//      byte compare + (len, tag). Tag order read_end < write_end <
+//      write_begin < read_begin at equal keys makes half-open range
+//      overlap equal index-interval overlap (same trick as the TPU
+//      kernel's packing, resolver/packing.py).
+//   2. Ranks of every endpoint in the resident history come from one
+//      galloping merge walk (history and endpoints are both sorted), so
+//      rank cost is O(P log gap) rather than O(P log C).
+//   3. Read-vs-history is a range-max over the version array between the
+//      endpoint ranks: answered O(1) per read from a two-level RMQ
+//      (block maxima + sparse table over blocks) rebuilt per batch.
+//   4. The sequential intra-batch rule ("reads of txn t vs writes of
+//      earlier still-committed txns") is answered EXACTLY with two
+//      Fenwick trees over endpoint positions: a committed write [wb,we)
+//      overlaps read [rb,re) iff pos(wb) < pos(re) and pos(we) > pos(rb),
+//      so the overlap count is  #(wb < re) - #(we <= rb)  — two prefix
+//      sums, two point updates per committed write.
+//   5. Committed writes are merged into the history (and the GC horizon
+//      applied: clamp-to-zero + coalesce, cpu.py _gc) in ONE output pass
+//      over (history ∪ committed write endpoints), rebuilding the entry
+//      arrays and the key arena.
+//
+// Keys are arbitrary byte strings, stored as (8-byte big-endian prefix,
+// length, offset) into an append-only arena; compares touch the arena only
+// when prefixes collide beyond 8 bytes.
+
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+#include <algorithm>
+#include <vector>
+
+namespace {
+
+using std::int32_t;
+using std::int64_t;
+using std::uint32_t;
+using std::uint64_t;
+using std::uint8_t;
+
+static inline uint64_t key_prefix(const uint8_t* p, int32_t len) {
+    uint64_t v = 0;
+    int32_t n = len < 8 ? len : 8;
+    for (int32_t i = 0; i < n; i++) v = (v << 8) | p[i];
+    v <<= 8 * (8 - n);
+    return v;
+}
+
+// Byte-lexicographic order with shorter-first tiebreak (== FDB key order;
+// also == the zero-padded-words-then-length order the TPU packing uses).
+static inline int cmp_tail(const uint8_t* a, int32_t la, const uint8_t* b,
+                           int32_t lb) {
+    // Prefixes (first 8 bytes) already known equal.
+    int32_t m = (la < lb ? la : lb);
+    if (m > 8) {
+        int c = memcmp(a + 8, b + 8, (size_t)(m - 8));
+        if (c) return c;
+    }
+    return (la > lb) - (la < lb);
+}
+
+struct CSet {
+    // Parallel entry arrays, sorted ascending by key; entry 0 is always
+    // the empty key "" (the step-function base, cpu.py _keys[0]).
+    std::vector<uint64_t> pre;
+    std::vector<int32_t> len;
+    std::vector<int64_t> off;   // into arena
+    std::vector<int64_t> ver;
+    std::vector<uint8_t> arena;
+    int64_t oldest = 0;
+
+    // Scratch reused across resolves (sized to the largest batch seen).
+    std::vector<uint32_t> s_idx, s_tmp;
+    std::vector<uint64_t> s_key;
+    std::vector<uint32_t> s_cnt;
+    std::vector<int32_t> s_pos;       // endpoint -> sorted position
+    std::vector<int32_t> s_lb, s_ub;  // endpoint -> history ranks
+    std::vector<int64_t> s_blockmax;
+    std::vector<int64_t> s_sparse;
+    std::vector<int32_t> s_fen_wb, s_fen_we;
+    // Rebuild targets (swapped with the live arrays after the merge pass).
+    std::vector<uint64_t> n_pre;
+    std::vector<int32_t> n_len;
+    std::vector<int64_t> n_off;
+    std::vector<int64_t> n_ver;
+    std::vector<uint8_t> n_arena;
+
+    const uint8_t* key_bytes(size_t i) const { return arena.data() + off[i]; }
+
+    int cmp_entry_vs(size_t i, uint64_t qpre, const uint8_t* qp,
+                     int32_t qlen) const {
+        if (pre[i] != qpre) return pre[i] < qpre ? -1 : 1;
+        return cmp_tail(key_bytes(i), len[i], qp, qlen);
+    }
+};
+
+// ---------------------------------------------------------------------------
+// Endpoint model. Endpoint order in the flat arrays (and the tag values):
+// [r_end (tag 0) | w_end (tag 1) | w_begin (tag 2) | r_begin (tag 3)].
+// ---------------------------------------------------------------------------
+enum { TAG_RE = 0, TAG_WE = 1, TAG_WB = 2, TAG_RB = 3 };
+
+struct Batch {
+    int n_txns, n_reads, n_writes, n_ep;
+    const uint8_t* blob;
+    const int32_t *r_txn, *w_txn;
+    const int64_t *rb_off, *re_off, *wb_off, *we_off;
+    const int32_t *rb_len, *re_len, *wb_len, *we_len;
+
+    // Endpoint i -> (offset, length, tag, row).
+    inline void ep(int i, int64_t& o, int32_t& l, int& tag, int& row) const {
+        if (i < n_reads) {
+            o = re_off[i]; l = re_len[i]; tag = TAG_RE; row = i;
+        } else if (i < n_reads + n_writes) {
+            row = i - n_reads;
+            o = we_off[row]; l = we_len[row]; tag = TAG_WE;
+        } else if (i < n_reads + 2 * n_writes) {
+            row = i - n_reads - n_writes;
+            o = wb_off[row]; l = wb_len[row]; tag = TAG_WB;
+        } else {
+            row = i - n_reads - 2 * n_writes;
+            o = rb_off[row]; l = rb_len[row]; tag = TAG_RB;
+        }
+    }
+};
+
+// Stable LSD radix sort of endpoint indices by 64-bit prefix (4 x 16-bit
+// passes), then refinement of equal-prefix runs by full key + (len, tag).
+static void sort_endpoints(CSet& cs, const Batch& b) {
+    int n = b.n_ep;
+    cs.s_idx.resize(n);
+    cs.s_tmp.resize(n);
+    cs.s_key.resize(n);
+    for (int i = 0; i < n; i++) {
+        int64_t o; int32_t l; int tag, row;
+        b.ep(i, o, l, tag, row);
+        cs.s_idx[i] = (uint32_t)i;
+        cs.s_key[i] = key_prefix(b.blob + o, l);
+    }
+    cs.s_cnt.assign(1 << 16, 0);
+    for (int pass = 0; pass < 4; pass++) {
+        int shift = 16 * pass;
+        uint32_t* cnt = cs.s_cnt.data();
+        memset(cnt, 0, sizeof(uint32_t) << 16);
+        for (int i = 0; i < n; i++)
+            cnt[(cs.s_key[cs.s_idx[i]] >> shift) & 0xffff]++;
+        uint32_t sum = 0;
+        for (int d = 0; d < (1 << 16); d++) {
+            uint32_t c = cnt[d];
+            cnt[d] = sum;
+            sum += c;
+        }
+        for (int i = 0; i < n; i++) {
+            uint32_t ix = cs.s_idx[i];
+            cs.s_tmp[cnt[(cs.s_key[ix] >> shift) & 0xffff]++] = ix;
+        }
+        cs.s_idx.swap(cs.s_tmp);
+    }
+    // Refine equal-prefix runs (typically a handful of endpoints sharing a
+    // key) with the full comparator.
+    auto full_less = [&](uint32_t ia, uint32_t ib) {
+        int64_t oa, ob; int32_t la, lb; int ta, tb, ra, rb;
+        b.ep((int)ia, oa, la, ta, ra);
+        b.ep((int)ib, ob, lb, tb, rb);
+        int c = cmp_tail(b.blob + oa, la, b.blob + ob, lb);
+        if (c) return c < 0;
+        if (ta != tb) return ta < tb;
+        return ia < ib;  // stable
+    };
+    int i = 0;
+    while (i < n) {
+        int j = i + 1;
+        uint64_t k = cs.s_key[cs.s_idx[i]];
+        while (j < n && cs.s_key[cs.s_idx[j]] == k) j++;
+        if (j - i > 1)
+            std::sort(cs.s_idx.begin() + i, cs.s_idx.begin() + j, full_less);
+        i = j;
+    }
+}
+
+// Galloping merge of the sorted endpoints against the sorted history:
+// lb[e] = #history < key(e), ub[e] = #history <= key(e).
+static void rank_endpoints(CSet& cs, const Batch& b) {
+    int n = b.n_ep;
+    size_t C = cs.pre.size();
+    cs.s_lb.resize(n);
+    cs.s_ub.resize(n);
+    size_t h = 0;
+    for (int p = 0; p < n; p++) {
+        int e = (int)cs.s_idx[p];
+        int64_t o; int32_t l; int tag, row;
+        b.ep(e, o, l, tag, row);
+        uint64_t qpre = cs.s_key[e];
+        const uint8_t* qp = b.blob + o;
+        // Gallop forward while history < query.
+        size_t step = 1;
+        while (h < C && cs.cmp_entry_vs(h, qpre, qp, l) < 0) {
+            size_t nx = h + step;
+            if (nx < C && cs.cmp_entry_vs(nx, qpre, qp, l) < 0) {
+                h = nx;
+                step <<= 1;
+            } else {
+                // Binary search in (h, min(h+step, C)).
+                size_t lo = h + 1, hi = (nx < C ? nx : C);
+                while (lo < hi) {
+                    size_t mid = (lo + hi) / 2;
+                    if (cs.cmp_entry_vs(mid, qpre, qp, l) < 0) lo = mid + 1;
+                    else hi = mid;
+                }
+                h = lo;
+                break;
+            }
+        }
+        cs.s_lb[e] = (int32_t)h;
+        int eq = (h < C && cs.cmp_entry_vs(h, qpre, qp, l) == 0) ? 1 : 0;
+        cs.s_ub[e] = (int32_t)h + eq;
+    }
+}
+
+// Two-level range-max over the version array: block maxima (block = 16)
+// plus a sparse table over blocks. O(C) build, O(1)+edges per query.
+struct RMQ {
+    static const int BLK = 16;
+    const int64_t* v;
+    int64_t C;
+    std::vector<int64_t>* bm;
+    std::vector<int64_t>* sp;
+    int64_t nb, levels;
+
+    void build(CSet& cs) {
+        v = cs.ver.data();
+        C = (int64_t)cs.ver.size();
+        bm = &cs.s_blockmax;
+        sp = &cs.s_sparse;
+        nb = (C + BLK - 1) / BLK;
+        bm->resize(nb);
+        for (int64_t i = 0; i < nb; i++) {
+            int64_t m = INT64_MIN, e = std::min(C, (i + 1) * (int64_t)BLK);
+            for (int64_t j = i * BLK; j < e; j++) m = std::max(m, v[j]);
+            (*bm)[i] = m;
+        }
+        levels = 1;
+        while ((1LL << levels) <= nb) levels++;
+        sp->resize(levels * nb);
+        std::copy(bm->begin(), bm->end(), sp->begin());
+        for (int64_t k = 1; k < levels; k++) {
+            int64_t half = 1LL << (k - 1);
+            for (int64_t i = 0; i < nb; i++) {
+                int64_t a = (*sp)[(k - 1) * nb + i];
+                int64_t bidx = i + half;
+                int64_t bb = bidx < nb ? (*sp)[(k - 1) * nb + bidx] : INT64_MIN;
+                (*sp)[k * nb + i] = std::max(a, bb);
+            }
+        }
+    }
+
+    // max over [lo, hi); caller guarantees lo < hi.
+    inline int64_t query(int64_t lo, int64_t hi) const {
+        int64_t blo = lo / BLK, bhi = (hi - 1) / BLK;
+        if (blo == bhi) {
+            int64_t m = INT64_MIN;
+            for (int64_t j = lo; j < hi; j++) m = std::max(m, v[j]);
+            return m;
+        }
+        int64_t m = INT64_MIN;
+        for (int64_t j = lo; j < (blo + 1) * BLK; j++) m = std::max(m, v[j]);
+        for (int64_t j = bhi * BLK; j < hi; j++) m = std::max(m, v[j]);
+        if (blo + 1 <= bhi - 1) {
+            int64_t nblk = bhi - 1 - blo;  // blocks in [blo+1, bhi)
+            int64_t k = 0;
+            while ((2LL << k) <= nblk) k++;
+            int64_t a = (*sp)[k * nb + blo + 1];
+            int64_t b2 = (*sp)[k * nb + bhi - (1LL << k)];
+            m = std::max(m, std::max(a, b2));
+        }
+        return m;
+    }
+};
+
+struct Fenwick {
+    std::vector<int32_t>* t;
+    int n;
+    void init(std::vector<int32_t>& buf, int size) {
+        t = &buf;
+        n = size;
+        buf.assign((size_t)size + 1, 0);
+    }
+    inline void add(int i) {  // point +1 at position i (0-based)
+        for (i++; i <= n; i += i & (-i)) (*t)[i]++;
+    }
+    inline int32_t prefix(int i) const {  // sum of positions < i
+        int32_t s = 0;
+        for (; i > 0; i -= i & (-i)) s += (*t)[i];
+        return s;
+    }
+};
+
+enum { ST_COMMITTED = 0, ST_CONFLICT = 1, ST_TOO_OLD = 2 };
+
+static int resolve(CSet& cs, int64_t version, int64_t new_oldest,
+                   const Batch& b, const int64_t* snapshots,
+                   const uint8_t* has_reads, uint8_t* statuses) {
+    int T = b.n_txns, R = b.n_reads, W = b.n_writes;
+    int n_ep = b.n_ep;
+
+    // Phase 0: tooOld against the PRE-batch horizon (cpu.py resolve).
+    for (int t = 0; t < T; t++)
+        statuses[t] =
+            (snapshots[t] < cs.oldest && has_reads[t]) ? ST_TOO_OLD
+                                                       : ST_COMMITTED;
+
+    sort_endpoints(cs, b);
+    rank_endpoints(cs, b);
+    cs.s_pos.resize(n_ep);
+    for (int p = 0; p < n_ep; p++) cs.s_pos[cs.s_idx[p]] = p;
+
+    // Phase 1: read-vs-history (CheckMax). max version over
+    // [ub(begin)-1, lb(end)); nonempty because "" <= begin < end.
+    RMQ rmq;
+    rmq.build(cs);
+    for (int r = 0; r < R; r++) {
+        int t = b.r_txn[r];
+        if (statuses[t] != ST_COMMITTED) continue;
+        int e_beg = R + 2 * W + r;  // TAG_RB endpoint index
+        int e_end = r;              // TAG_RE endpoint index
+        int64_t lo = cs.s_ub[e_beg] - 1;
+        int64_t hi = cs.s_lb[e_end];
+        if (lo < hi && rmq.query(lo, hi) > snapshots[t])
+            statuses[t] = ST_CONFLICT;
+    }
+
+    // Phase 2: sequential intra-batch. Reads and writes are flattened in
+    // txn order, so per-txn row spans are contiguous.
+    Fenwick fwb, fwe;
+    fwb.init(cs.s_fen_wb, n_ep);
+    fwe.init(cs.s_fen_we, n_ep);
+    int r_at = 0, w_at = 0;
+    for (int t = 0; t < T; t++) {
+        int r0 = r_at, w0 = w_at;
+        while (r_at < R && b.r_txn[r_at] == t) r_at++;
+        while (w_at < W && b.w_txn[w_at] == t) w_at++;
+        if (statuses[t] != ST_COMMITTED) continue;
+        bool conflict = false;
+        for (int r = r0; r < r_at && !conflict; r++) {
+            int pb = cs.s_pos[R + 2 * W + r];  // pos(read begin)
+            int pe = cs.s_pos[r];              // pos(read end)
+            // #(committed wb < pe) - #(committed we <= pb)
+            if (fwb.prefix(pe) - fwe.prefix(pb + 1) > 0) conflict = true;
+        }
+        if (conflict) {
+            statuses[t] = ST_CONFLICT;
+        } else {
+            for (int w = w0; w < w_at; w++) {
+                fwb.add(cs.s_pos[R + W + w]);  // write begin
+                fwe.add(cs.s_pos[R + w]);      // write end
+            }
+        }
+    }
+
+    // Phases 3+4: merge committed writes at `version` into the step
+    // function, clamp at the advanced horizon, coalesce — one output pass.
+    int64_t oldest_eff = std::max(cs.oldest, new_oldest);
+
+    size_t C = cs.pre.size();
+    cs.n_pre.clear(); cs.n_len.clear(); cs.n_off.clear(); cs.n_ver.clear();
+    cs.n_arena.clear();
+    cs.n_pre.reserve(C + 2 * (size_t)W);
+    cs.n_len.reserve(C + 2 * (size_t)W);
+    cs.n_off.reserve(C + 2 * (size_t)W);
+    cs.n_ver.reserve(C + 2 * (size_t)W);
+    cs.n_arena.reserve(cs.arena.size() + 64);
+
+    int64_t last_emitted = INT64_MIN;  // coalesce filter on the clamped value
+    auto emit = [&](uint64_t p, int32_t l, const uint8_t* bytes, int64_t v) {
+        if (v <= oldest_eff) v = 0;
+        if (!cs.n_ver.empty() && last_emitted == v) return;
+        cs.n_pre.push_back(p);
+        cs.n_len.push_back(l);
+        cs.n_off.push_back((int64_t)cs.n_arena.size());
+        cs.n_arena.insert(cs.n_arena.end(), bytes, bytes + l);
+        cs.n_ver.push_back(v);
+        last_emitted = v;
+    };
+
+    // Walk committed write endpoints in sorted order with a depth counter:
+    // depth 0->1 opens a union range, 1->0 tentatively closes it. A close
+    // is PENDING until the next committed endpoint: if the next union
+    // range opens at exactly the closing key, the two ranges fuse (the
+    // oracle's later set_range overwrites the shared boundary — both carry
+    // the same batch version, so [a,k)+[k,c) == [a,c)).
+    size_t h = 0;  // history cursor (index into the pre-batch entry arrays)
+    int depth = 0;
+    int open_e = -1, pending_close_e = -1;
+
+    auto key_eq = [&](int ea, int eb) {
+        int64_t oa, ob; int32_t la, lb2; int ta, tb, ra, rb;
+        b.ep(ea, oa, la, ta, ra);
+        b.ep(eb, ob, lb2, tb, rb);
+        return cs.s_key[ea] == cs.s_key[eb] &&
+               cmp_tail(b.blob + oa, la, b.blob + ob, lb2) == 0;
+    };
+    auto finalize = [&](int oe, int ce) {
+        int64_t oo, co; int32_t ol, cl; int t_, r_;
+        b.ep(oe, oo, ol, t_, r_);
+        b.ep(ce, co, cl, t_, r_);
+        int32_t lb_open = cs.s_lb[oe];
+        int32_t lb_end = cs.s_lb[ce];
+        // Copy history strictly below the range begin (an exact entry AT
+        // the begin key sits at index lb_open and is replaced below).
+        while ((int32_t)h < lb_open) {
+            emit(cs.pre[h], cs.len[h], cs.key_bytes(h), cs.ver[h]);
+            h++;
+        }
+        emit(cs.s_key[oe], ol, b.blob + oo, version);
+        h = (size_t)lb_end;  // skip history inside [begin, end)
+        // Restore the prior value at end unless history holds an exact
+        // entry there (it is emitted naturally by the next copy run and
+        // already carries version_at(end)).
+        if (cs.s_ub[ce] == lb_end)
+            emit(cs.s_key[ce], cl, b.blob + co, cs.ver[lb_end - 1]);
+    };
+
+    for (int p = 0; p < n_ep; p++) {
+        int e = (int)cs.s_idx[p];
+        int tag, row; int64_t o; int32_t l;
+        b.ep(e, o, l, tag, row);
+        if (tag != TAG_WB && tag != TAG_WE) continue;
+        if (statuses[b.w_txn[row]] != ST_COMMITTED) continue;
+        if (tag == TAG_WB) {
+            if (depth++ == 0) {
+                if (pending_close_e >= 0 && key_eq(e, pending_close_e)) {
+                    pending_close_e = -1;  // fuse: same union range continues
+                } else {
+                    if (pending_close_e >= 0) {
+                        finalize(open_e, pending_close_e);
+                        pending_close_e = -1;
+                    }
+                    open_e = e;
+                }
+            }
+        } else if (--depth == 0) {
+            pending_close_e = e;
+        }
+    }
+    if (pending_close_e >= 0) finalize(open_e, pending_close_e);
+    while (h < C) {
+        emit(cs.pre[h], cs.len[h], cs.key_bytes(h), cs.ver[h]);
+        h++;
+    }
+
+    cs.pre.swap(cs.n_pre);
+    cs.len.swap(cs.n_len);
+    cs.off.swap(cs.n_off);
+    cs.ver.swap(cs.n_ver);
+    cs.arena.swap(cs.n_arena);
+    cs.oldest = oldest_eff;
+    return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* fdbcs_create(int64_t init_version) {
+    CSet* cs = new CSet();
+    cs->pre.push_back(0);
+    cs->len.push_back(0);
+    cs->off.push_back(0);
+    cs->ver.push_back(init_version);
+    return cs;
+}
+
+void fdbcs_destroy(void* h) { delete (CSet*)h; }
+
+int64_t fdbcs_entry_count(void* h) { return (int64_t)((CSet*)h)->pre.size(); }
+
+int64_t fdbcs_oldest(void* h) { return ((CSet*)h)->oldest; }
+
+// Copy entries out for differential tests. Returns the entry count.
+// key bytes are concatenated into key_buf (caller sizes it via
+// fdbcs_arena_size); offs/lens/vers receive per-entry fields.
+int64_t fdbcs_arena_size(void* h) {
+    return (int64_t)((CSet*)h)->arena.size();
+}
+
+int64_t fdbcs_entries(void* h, uint8_t* key_buf, int64_t* offs, int32_t* lens,
+                      int64_t* vers, int64_t max_n) {
+    CSet* cs = (CSet*)h;
+    int64_t n = (int64_t)cs->pre.size();
+    if (n > max_n) n = max_n;
+    int64_t at = 0;
+    for (int64_t i = 0; i < n; i++) {
+        memcpy(key_buf + at, cs->key_bytes((size_t)i), (size_t)cs->len[i]);
+        offs[i] = at;
+        lens[i] = cs->len[i];
+        vers[i] = cs->ver[i];
+        at += cs->len[i];
+    }
+    return n;
+}
+
+// Resolve one batch. Reads/writes are flattened across txns IN TXN ORDER
+// (r_txn / w_txn non-decreasing); ranges of tooOld txns must have been
+// dropped by the caller (mirroring flatten_batch's admission rules), and
+// has_reads[t] carries the pre-drop "txn had read ranges" bit the tooOld
+// rule needs. Returns 0; statuses_out[t] in {0 committed, 1 conflict,
+// 2 tooOld}.
+int fdbcs_resolve(void* h, int64_t version, int64_t new_oldest, int32_t n_txns,
+                  const int64_t* snapshots, const uint8_t* has_reads,
+                  const uint8_t* blob, int32_t n_reads, const int32_t* r_txn,
+                  const int64_t* rb_off, const int32_t* rb_len,
+                  const int64_t* re_off, const int32_t* re_len,
+                  int32_t n_writes, const int32_t* w_txn,
+                  const int64_t* wb_off, const int32_t* wb_len,
+                  const int64_t* we_off, const int32_t* we_len,
+                  uint8_t* statuses_out) {
+    CSet* cs = (CSet*)h;
+    Batch b;
+    b.n_txns = n_txns;
+    b.n_reads = n_reads;
+    b.n_writes = n_writes;
+    b.n_ep = 2 * n_reads + 2 * n_writes;
+    b.blob = blob;
+    b.r_txn = r_txn;
+    b.w_txn = w_txn;
+    b.rb_off = rb_off; b.rb_len = rb_len;
+    b.re_off = re_off; b.re_len = re_len;
+    b.wb_off = wb_off; b.wb_len = wb_len;
+    b.we_off = we_off; b.we_len = we_len;
+    return resolve(*cs, version, new_oldest, b, snapshots, has_reads,
+                   statuses_out);
+}
+
+}  // extern "C"

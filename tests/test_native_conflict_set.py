@@ -1,0 +1,160 @@
+"""Differential tests: native C++ conflict detector vs the Python oracle.
+
+Same shape as the TPU kernel's differential suite: random workloads with
+mixed-length keys, range writes, GC horizon advances and tooOld txns must
+produce bit-identical statuses AND entries() (the full step function, not
+just verdicts) against ConflictSetCPU.
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from foundationdb_tpu.kv.keys import KeyRange
+from foundationdb_tpu.resolver.cpu import ConflictSetCPU
+from foundationdb_tpu.resolver.types import TxnConflictInfo
+
+native_cpu = pytest.importorskip("foundationdb_tpu.resolver.native_cpu")
+
+if native_cpu.load() is None:  # pragma: no cover
+    pytest.skip("native conflict set not built", allow_module_level=True)
+
+
+def k8(x: int) -> bytes:
+    return struct.pack(">Q", x)
+
+
+def gen_txns(rng, n, version, key_space=512, lag=200, mixed_len=False,
+             wide=False):
+    txns = []
+    for _ in range(n):
+        def key():
+            a = int(rng.integers(0, key_space))
+            if mixed_len:
+                pick = int(rng.integers(0, 3))
+                if pick == 0:
+                    return bytes([a % 250])
+                if pick == 1:
+                    return k8(a) + bytes(int(rng.integers(0, 9))) + b"x"
+            return k8(a)
+
+        rr = []
+        for _ in range(int(rng.integers(0, 5))):
+            b = key()
+            if wide and rng.random() < 0.5:
+                e = key()
+                if e <= b:
+                    e = b + b"\x00" + e
+            else:
+                e = b + b"\x00"
+            rr.append(KeyRange(b, e))
+        wr = []
+        for _ in range(int(rng.integers(0, 3))):
+            b = key()
+            if wide and rng.random() < 0.5:
+                e = key()
+                if e <= b:
+                    e = b + b"\x00" + e
+            else:
+                e = b + b"\x00"
+            wr.append(KeyRange(b, e))
+        snap = version - int(rng.integers(0, lag))
+        txns.append(TxnConflictInfo(snap, rr, wr))
+    return txns
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+@pytest.mark.parametrize("mixed_len,wide", [
+    (False, False), (True, False), (False, True), (True, True),
+])
+def test_native_matches_oracle(seed, mixed_len, wide):
+    rng = np.random.default_rng(seed)
+    oracle = ConflictSetCPU()
+    native = native_cpu.ConflictSetNativeCPU()
+    version = 1000
+    for step in range(12):
+        txns = gen_txns(rng, 40, version, mixed_len=mixed_len, wide=wide)
+        new_oldest = max(0, version - int(rng.integers(50, 400)))
+        want = oracle.resolve(version, new_oldest, txns)
+        got = native.resolve(version, new_oldest, txns)
+        assert got.statuses == want.statuses, f"step {step}"
+        assert native.entries() == oracle.entries(), f"step {step}"
+        version += int(rng.integers(10, 120))
+
+
+def test_native_empty_and_degenerate_batches():
+    oracle = ConflictSetCPU()
+    native = native_cpu.ConflictSetNativeCPU()
+    # Empty batch still advances + coalesces (gc runs every resolve).
+    for cs in (oracle, native):
+        cs.resolve(100, 0, [TxnConflictInfo(90, [], [KeyRange(k8(5), k8(9))])])
+    assert native.entries() == oracle.entries()
+    for cs in (oracle, native):
+        cs.resolve(200, 150, [])
+    assert native.entries() == oracle.entries()
+    # Write-only txns never conflict; a later read of an earlier txn's
+    # intra-batch write does.
+    txns = [
+        TxnConflictInfo(160, [], [KeyRange(k8(6), k8(7))]),
+        TxnConflictInfo(160, [(KeyRange(k8(6), k8(7)))], []),
+    ]
+    w = oracle.resolve(300, 150, txns)
+    g = native.resolve(300, 150, txns)
+    assert g.statuses == w.statuses == [0, 1]
+    assert native.entries() == oracle.entries()
+
+
+def test_native_too_old():
+    oracle = ConflictSetCPU()
+    native = native_cpu.ConflictSetNativeCPU()
+    for cs in (oracle, native):
+        cs.resolve(100, 80, [TxnConflictInfo(95, [], [KeyRange(k8(1), k8(2))])])
+    txns = [
+        TxnConflictInfo(50, [KeyRange(k8(1), k8(2))], []),   # tooOld
+        TxnConflictInfo(50, [], [KeyRange(k8(3), k8(4))]),   # write-only: ok
+        TxnConflictInfo(90, [KeyRange(k8(9), k8(10))], []),  # fine
+    ]
+    w = oracle.resolve(120, 80, txns)
+    g = native.resolve(120, 80, txns)
+    assert g.statuses == w.statuses == [2, 0, 0]
+    assert native.entries() == oracle.entries()
+
+
+def test_native_adjacent_and_overlapping_writes_fuse():
+    """Adjacent committed ranges [a,k)+[k,c) and overlapping ranges must
+    leave the same coalesced step function as the oracle."""
+    oracle = ConflictSetCPU()
+    native = native_cpu.ConflictSetNativeCPU()
+    txns = [
+        TxnConflictInfo(0, [], [KeyRange(k8(10), k8(20))]),
+        TxnConflictInfo(0, [], [KeyRange(k8(20), k8(30))]),
+        TxnConflictInfo(0, [], [KeyRange(k8(25), k8(40))]),
+        TxnConflictInfo(0, [], [KeyRange(k8(50), k8(60))]),
+    ]
+    w = oracle.resolve(10, 0, txns)
+    g = native.resolve(10, 0, txns)
+    assert g.statuses == w.statuses
+    assert native.entries() == oracle.entries()
+    # Overwrite interior + exact-end-entry cases.
+    txns2 = [TxnConflictInfo(10, [], [KeyRange(k8(15), k8(50))])]
+    oracle.resolve(20, 0, txns2)
+    native.resolve(20, 0, txns2)
+    assert native.entries() == oracle.entries()
+
+
+@pytest.mark.parametrize("seed", [7, 8])
+def test_native_long_soak_vs_oracle(seed):
+    rng = np.random.default_rng(seed)
+    oracle = ConflictSetCPU()
+    native = native_cpu.ConflictSetNativeCPU()
+    version = 10_000
+    for step in range(40):
+        txns = gen_txns(rng, 25, version, key_space=96, lag=300,
+                        mixed_len=True, wide=True)
+        new_oldest = max(0, version - 500)
+        want = oracle.resolve(version, new_oldest, txns)
+        got = native.resolve(version, new_oldest, txns)
+        assert got.statuses == want.statuses, f"step {step}"
+        version += int(rng.integers(5, 80))
+    assert native.entries() == oracle.entries()
