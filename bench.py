@@ -194,7 +194,10 @@ def measure_tpu(batch_txns: int, n_batches: int, key_space: int, seed: int,
 
     for name, sampler in configs:
         rng = np.random.default_rng(seed)
-        cs = ConflictSetTPU(max_key_bytes=8, initial_capacity=capacity)
+        # Uniform history grows without GC: pin the capacity (no resize
+        # recompiles); zipf/sliding below let the shrink floor follow GC.
+        cs = ConflictSetTPU(max_key_bytes=8, initial_capacity=capacity,
+                            min_capacity=capacity if name == "uniform" else 64)
         version = 1_000_000
         # Pre-generate + pack all batches (host work measured separately
         # from device work). Base never advances here (window >> run), so
@@ -287,6 +290,28 @@ def measure_tpu(batch_txns: int, n_batches: int, key_space: int, seed: int,
 
     group = 2  # batches fetched per device sync (readback amortization)
 
+    # Workload generation is HARNESS cost, not system cost (in production
+    # the txns arrive deserialized from the wire): pre-generate a pool of
+    # batches outside the measured loop, with snapshots pre-set for each
+    # batch's known use version so NO per-txn Python work happens inside
+    # the timed region. Only runs past the pool size (non-default
+    # n_batches) pay an in-loop snapshot refresh when a batch is reused.
+    # Packing stays inside the loop — that IS the system's host-side work.
+    pool_n = min(fill + n_batches, 24)
+    pool = [
+        gen_batch(rng, batch_txns, version + b * version_step, sampler)
+        for b in range(pool_n)
+    ]
+    snap_lag = rng.integers(0, 100_000, size=(pool_n, batch_txns))
+
+    def batch_for(b: int, v: int):
+        txns = pool[b % pool_n]
+        if b >= pool_n:  # reused entry: refresh snapshots to this version
+            lags = snap_lag[b % pool_n]
+            for i, t in enumerate(txns):
+                t.read_snapshot = v - int(lags[i])
+        return txns
+
     def drain(record: bool) -> None:
         # Always fetch in `group`-sized chunks (plus singles for the
         # remainder) so the steady-state concat shape is the ONLY concat
@@ -302,7 +327,7 @@ def measure_tpu(batch_txns: int, n_batches: int, key_space: int, seed: int,
 
     for b in range(fill + n_batches):
         v = version + b * version_step
-        txns = gen_batch(rng, batch_txns, v, sampler)
+        txns = batch_for(b, v)
         pb = cs.pack(txns)
         if b == fill:
             # Drain warm-fill work so the measured region starts clean.
@@ -345,7 +370,8 @@ def measure_tpu(batch_txns: int, n_batches: int, key_space: int, seed: int,
         # so the pessimistic growth bound stays under `capacity` for this
         # run length — no mid-run grow+recompile, and no oversized state
         # (a larger C would slow every history-scaled pass).
-        cs = ConflictSetTPU(max_key_bytes=8, initial_capacity=capacity)
+        cs = ConflictSetTPU(max_key_bytes=8, initial_capacity=capacity,
+                            min_capacity=capacity)
         lat = []
         v = 1_000_000
         nb = 4
@@ -381,7 +407,8 @@ def measure_tpu(batch_txns: int, n_batches: int, key_space: int, seed: int,
         # size independent) and marginal (real compute per txn); then
         # recombine under documented co-located assumptions.
         n_small = 2048
-        cs2 = ConflictSetTPU(max_key_bytes=8, initial_capacity=capacity)
+        cs2 = ConflictSetTPU(max_key_bytes=8, initial_capacity=capacity,
+                             min_capacity=capacity)
         small_lat = []
         small_pb = None
         for b in range(5):

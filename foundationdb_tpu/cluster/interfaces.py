@@ -43,6 +43,17 @@ class GetReadVersionRequest:
 
 
 @dataclass
+class ConfirmEpochLiveRequest:
+    """Proxy -> tlog liveness check backing every GRV batch (ref:
+    confirmEpochLive, TagPartitionedLogSystem.actor.cpp:553). The reply
+    resolves iff the log still serves `epoch`; a log fenced by a newer
+    generation answers with TLogStopped."""
+
+    epoch: int
+    reply: Promise = field(default_factory=Promise)
+
+
+@dataclass
 class CommitTransactionRequest:
     """(ref: CommitTransactionRequest, MasterProxyInterface.h:76; the
     payload is CommitTransactionRef, CommitTransaction.h:89-105)."""

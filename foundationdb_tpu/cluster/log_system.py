@@ -117,6 +117,16 @@ class TagPartitionedLogSystem:
         ]
         await all_of([t.done for t in tasks])
 
+    async def confirm_epoch_live(self, epoch: int) -> None:
+        """GRV epoch-liveness (ref: confirmEpochLive,
+        TagPartitionedLogSystem.actor.cpp:553): every log of the quorum
+        must still be serving this generation — a partitioned old master
+        whose logs were locked by a successor must NOT hand out read
+        versions (its committed version may be behind commits the new
+        generation already made: stale reads)."""
+        for log in self.logs:
+            log.confirm_epoch(epoch)
+
     # -- recovery (ref: epochEnd :107) --
     def lock(self, epoch: int) -> int:
         assert epoch >= self.locked_epoch

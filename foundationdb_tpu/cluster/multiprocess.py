@@ -103,6 +103,15 @@ class TLogSkipToRequest:
 
 
 @dataclass
+class TLogConfirmEpochRequest:
+    """GRV epoch-liveness probe (ref: confirmEpochLive,
+    TagPartitionedLogSystem.actor.cpp:553). Replies with the log's locked
+    epoch; the caller compares against its own generation."""
+
+    reply: Promise = field(default_factory=Promise)
+
+
+@dataclass
 class TLogStatusRequest:
     """(ref: TLogQueuingMetricsRequest — ratekeeper's log-side input)."""
 
@@ -126,8 +135,8 @@ class StorageStatusRequest:
 
 for _cls in (
     TLogPeekRequest, TLogPopRequest, TLogLockRequest, TLogTruncateRequest,
-    TLogSkipToRequest, TLogStatusRequest, StorageRollbackRequest,
-    StorageStatusRequest, TaggedMutation,
+    TLogSkipToRequest, TLogStatusRequest, TLogConfirmEpochRequest,
+    StorageRollbackRequest, StorageStatusRequest, TaggedMutation,
 ):
     register_message(_cls)
 
@@ -235,6 +244,8 @@ class LogHost:
                 for _, tms in log._entries for tm in tms
             )
             return (log.version.get(), log.durable.get(), qbytes)
+        if isinstance(req, TLogConfirmEpochRequest):
+            return log.locked_epoch
         raise TypeError(f"unknown log request {type(req)}")
 
     def durable_all(self) -> int:
@@ -412,6 +423,20 @@ class RemoteLogSystem:
 
     async def skip_to(self, version: int) -> None:
         await self._control_all(lambda: TLogSkipToRequest(version))
+
+    async def confirm_epoch_live(self, epoch: int) -> None:
+        """(ref: confirmEpochLive :553.) Raises unless EVERY log of the
+        quorum answers and none is locked by a newer generation; an
+        unreachable log host means liveness cannot be proven and the GRV
+        must stall rather than risk a stale read."""
+        from ..core.errors import TLogStopped
+
+        results = await self._control_all(lambda: TLogConfirmEpochRequest())
+        for locked in results:
+            if locked > epoch:
+                raise TLogStopped(
+                    f"epoch {epoch} fenced by generation {locked}"
+                )
 
     async def refresh_status(self) -> None:
         results = await self._control_all(lambda: TLogStatusRequest())

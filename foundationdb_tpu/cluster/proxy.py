@@ -137,7 +137,10 @@ class CommitProxy:
         self._tasks.add(spawn(
             batcher(
                 self.grv_stream,
-                self._answer_grv_batch,
+                lambda b: self._tasks.add(spawn(
+                    self._answer_grv_batch(b), TaskPriority.GRV,
+                    name="grvBatch",
+                )),
                 interval=CLIENT_KNOBS.GRV_BATCH_INTERVAL,
                 max_count=CLIENT_KNOBS.MAX_BATCH_SIZE,
                 priority=TaskPriority.GRV,
@@ -158,7 +161,27 @@ class CommitProxy:
         self._tasks.cancel_all()
 
     # -- GRV --
-    def _answer_grv_batch(self, reqs: list[GetReadVersionRequest]) -> None:
+    async def _confirm_epoch_live(self) -> None:
+        """Every GRV batch confirms this generation's log quorum is still
+        live BEFORE answering (ref: MasterProxyServer.actor.cpp:875-889 ->
+        TagPartitionedLogSystem.actor.cpp:553). Without it, a partitioned
+        old-generation proxy/master pair could keep serving read versions
+        that predate commits the NEW generation already made — stale
+        reads, exactly when strict serializability matters most."""
+        from .interfaces import ConfirmEpochLiveRequest
+
+        if self.log_system is not None:
+            await self.log_system.confirm_epoch_live(self.generation)
+        elif self.tlog_endpoint is not None:
+            await self._call_endpoint(
+                self.tlog_endpoint, ConfirmEpochLiveRequest(self.generation)
+            )
+        else:
+            self.tlog.confirm_epoch(self.generation)
+
+    async def _answer_grv_batch(self, reqs: list[GetReadVersionRequest]) -> None:
+        if getattr(self, "_epoch_dead", False):
+            return  # deposed: clients time out and retry onto the successor
         # Admission control: when the ratekeeper's budget is exhausted the
         # batch is deferred, not denied — GRVs simply start later, which is
         # exactly how the reference's transactionStarter applies the rate
@@ -193,7 +216,36 @@ class CommitProxy:
         reqs = immediate + reqs
         if not reqs:
             return
+        # Read the version FIRST, then confirm the epoch: the confirmation
+        # postdating the read guarantees no newer generation had committed
+        # anything when this version was current (reference order,
+        # MasterProxyServer.actor.cpp:875-889).
         v = self.master.get_live_committed_version()
+        try:
+            await self._confirm_epoch_live()
+        except TLogStopped as e:
+            # PROVEN deposed (a log is fenced by a newer generation): latch
+            # dead. Answering would risk a stale read; clients time out,
+            # retry, and land on the successor via discovery.
+            self._epoch_dead = True
+            TraceEvent("ProxyEpochDead", severity=30).detail(
+                "Generation", self.generation
+            ).error(e).log()
+            return
+        except BaseException as e:
+            from ..core.errors import ActorCancelled
+
+            if isinstance(e, ActorCancelled):
+                raise
+            # Liveness UNPROVEN (e.g. one lost control RPC on a lossy
+            # link): drop this batch only — the next batch re-confirms,
+            # exactly the reference's per-batch stall-and-retry. No latch:
+            # a transient timeout must not permanently kill GRV service on
+            # a live generation.
+            TraceEvent("ProxyGRVEpochUnconfirmed", severity=20).detail(
+                "Generation", self.generation
+            ).error(e).log()
+            return
         TraceEvent("ProxyGRV").detail("Version", v).detail(
             "Count", len(reqs)
         ).log()

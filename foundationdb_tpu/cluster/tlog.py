@@ -83,6 +83,17 @@ class MemoryTLog:
         if epoch < self.locked_epoch:
             raise TLogStopped(f"locked by generation {self.locked_epoch}")
 
+    def confirm_epoch(self, epoch: int) -> None:
+        """confirmEpochLive's per-log check (ref: TagPartitionedLogSystem::
+        confirmEpochLive, fdbserver/TagPartitionedLogSystem.actor.cpp:553):
+        a generation may only act on this log — in particular, answer GRVs
+        from its master's committed version — while the log has not been
+        locked by a newer generation. Raises TLogStopped otherwise."""
+        if epoch < self.locked_epoch:
+            raise TLogStopped(
+                f"epoch {epoch} fenced by generation {self.locked_epoch}"
+            )
+
     async def peek(self, from_version: int) -> list[tuple[int, list]]:
         """All DURABLE entries with version > from_version; awaits until at
         least one exists (ref: tLogPeekMessages blocking peek). Non-durable
@@ -106,6 +117,11 @@ class MemoryTLog:
         from ..core.runtime import TaskPriority
 
         async def handle(req):
+            from .interfaces import ConfirmEpochLiveRequest
+
+            if isinstance(req, ConfirmEpochLiveRequest):
+                self.confirm_epoch(req.epoch)
+                return None
             await self.commit(req.prev_version, req.version, req.mutations,
                               epoch=req.epoch)
             return None

@@ -99,8 +99,11 @@ class StickyCaps:
     two paths cannot drift.
     """
 
+    _DIMS = 4  # reads, writes, explicit read ends, explicit write ends
+
     def __init__(self, decay_batches: int | None = None):
-        self._m: dict[int, list[int]] = {}  # T -> [r, w, er, ew, count]
+        # T -> [cap_r, cap_w, cap_er, cap_ew, epoch maxes x4, count]
+        self._m: dict[int, list[int]] = {}
         self._decay = decay_batches
 
     def _decay_batches(self) -> int:
@@ -110,36 +113,98 @@ class StickyCaps:
 
         return SERVER_KNOBS.TPU_STICKY_DECAY_BATCHES
 
-    def caps_for(self, n_txns: int) -> tuple[int, int, int]:
-        """(min_reads, min_writes, txn_bucket) to pass as pack_batch caps."""
+    def caps_for(self, n_txns: int) -> tuple[int, int, int, int, int]:
+        """(min_reads, min_writes, txn_bucket, min_expl_r, min_expl_w) to
+        pass as pack_batch caps."""
         t = next_bucket(max(n_txns, 1))
         e = self._m.get(t)
-        return (e[0], e[1], t) if e else (0, 0, t)
+        if e is None:
+            return (0, 0, t, 0, 0)
+        return (e[0], e[1], t, e[2], e[3])
 
     def update(self, pb: "PackedBatch") -> None:
-        self.update_counts(pb.layout, pb.n_reads, pb.n_writes)
+        self.update_counts(pb.layout, pb.n_reads, pb.n_writes,
+                           pb.n_expl_r, pb.n_expl_w)
 
-    def update_counts(self, lay: "FusedLayout", n_reads: int,
-                      n_writes: int) -> None:
-        nr_b = next_bucket(max(n_reads, 1))
-        nw_b = next_bucket(max(n_writes, 1))
-        e = self._m.setdefault(lay.T, [0, 0, 0, 0, 0])
-        e[0] = max(e[0], nr_b)
-        e[1] = max(e[1], nw_b)
-        e[2] = max(e[2], nr_b)
-        e[3] = max(e[3], nw_b)
-        e[4] += 1
-        if e[4] >= self._decay_batches():
-            e[0], e[1] = e[2], e[3]
-            e[2] = e[3] = e[4] = 0
+    def update_counts(self, lay: "FusedLayout", n_reads: int, n_writes: int,
+                      n_expl_r: int = 0, n_expl_w: int = 0) -> None:
+        D = self._DIMS
+        nat = (
+            next_bucket(max(n_reads, 1)),
+            next_bucket(max(n_writes, 1)),
+            next_bucket(n_expl_r) if n_expl_r else 0,
+            next_bucket(n_expl_w) if n_expl_w else 0,
+        )
+        e = self._m.setdefault(lay.T, [0] * (2 * D + 1))
+        for i in range(D):
+            e[i] = max(e[i], nat[i])
+            e[D + i] = max(e[D + i], nat[i])
+        e[2 * D] += 1
+        if e[2 * D] >= self._decay_batches():
+            for i in range(D):
+                e[i] = e[D + i]
+                e[D + i] = 0
+            e[2 * D] = 0
 
     def seed(self, lay: "FusedLayout") -> None:
         """Raise the caps to a warmed layout (ConflictSetTPU.warmup)."""
-        e = self._m.setdefault(lay.T, [0, 0, 0, 0, 0])
-        e[0] = max(e[0], lay.R)
-        e[1] = max(e[1], lay.Wr)
-        e[2] = max(e[2], lay.R)
-        e[3] = max(e[3], lay.Wr)
+        D = self._DIMS
+        e = self._m.setdefault(lay.T, [0] * (2 * D + 1))
+        for i, v in enumerate((lay.R, lay.Wr, lay.Er, lay.Ew)):
+            e[i] = max(e[i], v)
+            e[D + i] = max(e[D + i], v)
+
+
+_sort_native = None
+_sort_native_tried = False
+
+
+def _load_sort_native():
+    """ctypes handle to the native endpoint radix sort (conflict_set.cpp
+    fdbcs_sort_order), or None — np.lexsort is the fallback."""
+    global _sort_native, _sort_native_tried
+    if _sort_native_tried:
+        return _sort_native
+    _sort_native_tried = True
+    try:
+        import ctypes
+
+        from ..storage_engine import _native
+
+        lib = _native.load()
+        if lib is None or not hasattr(lib, "fdbcs_sort_order"):
+            return None
+        lib.fdbcs_sort_order.argtypes = [
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.fdbcs_sort_order.restype = ctypes.c_int32
+        _sort_native = lib
+    except Exception:  # noqa: BLE001 - fall back to numpy
+        _sort_native = None
+    return _sort_native
+
+
+def _sort_order(pair_keys: list, lt: np.ndarray, n: int) -> np.ndarray:
+    """Endpoint sort order by (key words, len<<3|tag). Single-u64 keys
+    (up to 8-byte packed width) ride the native stable radix sort
+    (~10x np.lexsort at ~1M rows — the sort is the largest single host
+    cost on the commit path); wider keys fall back to np.lexsort."""
+    lib = _load_sort_native()
+    if lib is not None and len(pair_keys) == 1 and n > 4096:
+        import ctypes
+
+        k = np.ascontiguousarray(pair_keys[0], dtype=np.uint64)
+        l32 = np.ascontiguousarray(lt, dtype=np.uint32)
+        out = np.empty(n, dtype=np.int32)
+        lib.fdbcs_sort_order(
+            k.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            l32.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            n,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+        return out
+    return np.lexsort((lt,) + tuple(reversed(pair_keys)))
 
 
 def pack_keys(keys: Sequence[bytes], n_words: int) -> tuple[np.ndarray, np.ndarray]:
@@ -259,29 +324,61 @@ def flatten_batch(txns: Sequence[TxnConflictInfo], oldest_version: int):
 TAG_RE, TAG_WE, TAG_WB, TAG_RB = 0, 1, 2, 3
 
 
+# Length-field encoding in the per-row key matrices: low 14 bits = key
+# length (pad sentinel 0x3FFF), bits 14-15 = end-derivation mode of the
+# row's range. The range END keys are mostly NOT shipped: a point range's
+# end is keyAfter(begin) (same words, len+1 — what FDB clients emit for
+# single-key accesses) or begin+1 in the integer key space (len equal,
+# words incremented with carry); only genuinely wide ends ride an explicit
+# side table. On the measured link bytes are latency, so every derivable
+# word stays on device.
+LEN_MASK = 0x3FFF
+LEN_PAD = 0x3FFF
+MODE_KEYAFTER = 0
+MODE_INCREMENT = 1
+MODE_EXPLICIT = 2
+
+
+def incr_packed_keys(words: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """+1 with carry over packed big-endian biased-int32 key words (the
+    packed image of begin+1 in the integer key space). Returns (words,
+    overflowed) — overflow means +1 is not representable at this width."""
+    raw = (words.view(np.int32).view(np.uint32) ^ BIAS).copy()
+    carry = np.ones(len(raw), dtype=bool)
+    for j in range(raw.shape[1] - 1, -1, -1):
+        raw[:, j] += carry.astype(np.uint32)
+        carry &= raw[:, j] == 0
+    return (raw ^ BIAS).view(np.int32), carry
+
+
 @dataclass
 class FusedLayout:
-    """Static layout of the fused int32 batch buffer.
+    """Static layout of the fused int32 batch buffer (compact form).
 
-    Segments, in order (all int32):
-      smat   (W+1)*P2  sorted endpoint key words + length row, word-major
+    Segments, in order (all int32; W1 = n_words+1):
+      rb_keys  W1*R    read-range BEGIN key words + len field, word-major
+      wb_keys  W1*Wr   write-range begin keys + len field
+      re_ext   W1*Er   explicit read END keys (only non-derivable ends)
+      we_ext   W1*Ew   explicit write end keys
       q_begin  R       sorted position of each read's begin endpoint
       q_end    R       sorted position of each read's end endpoint
       s_begin  Wr      sorted position of each write's begin endpoint
       s_end    Wr      sorted position of each write's end endpoint
-      rtxn     R       owning txn of each read row
-      rsnap    R       read snapshot as offset from the batch base version
-      wtxn     Wr      owning txn of each write row
-      w_valid  Wr      1 for real (non-pad) write rows
-      too_old  T       1 for tooOld txns
-      scalars  2       [version_off, oldest_off] (filled at resolve time)
+      tmeta    T       rcount | wcount<<13 | too_old<<26   per txn
+      tsnap    T       read snapshot as offset from the batch base
+      scalars  4       [version_off, oldest_off, n_reads, n_writes]
+
+    The kernel reconstructs on device everything the old fat layout
+    shipped: the (W1, P2) sorted endpoint matrix (4 column scatters of the
+    row keys at the shipped sorted positions, with end keys derived per
+    the mode bits), per-row txn ids (prefix sums over tmeta counts),
+    per-row snapshots (gather of tsnap), and write validity. At the
+    measured 20-40 MB/s link this halves the bytes of a point-range
+    batch; the added decode is ~a dozen device ops.
 
     The sort itself (np.lexsort) happens on host — XLA's TPU multi-operand
-    sort is catastrophically slow to compile (405 s measured for a 5-operand
-    sort) and the endpoints are materialized host-side anyway. Everything
-    derivable by cheap device ops (prefix sums over tags, same-as-previous
-    compares, canonical segment-tree nodes) is NOT shipped: it is cheaper to
-    recompute on device than to widen the single H2D transfer.
+    sort is catastrophically slow to compile (405 s measured for a
+    5-operand sort) and the endpoints are materialized host-side anyway.
     """
 
     n_words: int
@@ -289,25 +386,28 @@ class FusedLayout:
     R: int
     Wr: int
     T: int
+    Er: int = 0
+    Ew: int = 0
 
     def __post_init__(self):
         W1 = self.n_words + 1
         o = 0
-        self.off_smat = o; o += W1 * self.P2
+        self.off_rb = o; o += W1 * self.R
+        self.off_wb = o; o += W1 * self.Wr
+        self.off_re_ext = o; o += W1 * self.Er
+        self.off_we_ext = o; o += W1 * self.Ew
         self.off_q_begin = o; o += self.R
         self.off_q_end = o; o += self.R
         self.off_s_begin = o; o += self.Wr
         self.off_s_end = o; o += self.Wr
-        self.off_rtxn = o; o += self.R
-        self.off_rsnap = o; o += self.R
-        self.off_wtxn = o; o += self.Wr
-        self.off_w_valid = o; o += self.Wr
-        self.off_too_old = o; o += self.T
-        self.off_scalars = o; o += 2
+        self.off_tmeta = o; o += self.T
+        self.off_tsnap = o; o += self.T
+        self.off_scalars = o; o += 4
         self.total = o
 
     def key(self):
-        return (self.n_words, self.P2, self.R, self.Wr, self.T)
+        return (self.n_words, self.P2, self.R, self.Wr, self.T,
+                self.Er, self.Ew)
 
 
 @dataclass
@@ -325,6 +425,8 @@ class PackedBatch:
     base: int
     n_reads: int
     n_writes: int
+    n_expl_r: int = 0  # rows whose end key ships explicitly
+    n_expl_w: int = 0
 
     def set_scalars(self, version_off: int, oldest_off: int) -> None:
         self.buf[self.layout.off_scalars] = version_off
@@ -335,7 +437,7 @@ def pack_batch(
     txns: Sequence[TxnConflictInfo],
     oldest_version: int,
     n_words: int,
-    caps: tuple[int, int, int] | None = None,
+    caps: tuple | None = None,
 ) -> PackedBatch:
     """Flatten, sort and fuse a transaction batch into one int32 buffer.
 
@@ -344,16 +446,21 @@ def pack_batch(
     — the device then merges the sorted endpoints against the sorted
     resident history by rank arithmetic instead of re-sorting.
 
-    `caps`, if given, is (read_cap, write_cap, txn_cap) minimum row
-    capacities — the multi-resolver path packs every shard to common shapes
-    so the stacked tensors shard evenly over the mesh.
+    `caps`, if given, is (read_cap, write_cap, txn_cap[, expl_read_cap,
+    expl_write_cap]) minimum row capacities — the multi-resolver path packs
+    every shard to common shapes so the stacked tensors shard evenly over
+    the mesh, and StickyCaps pins layouts across jittering batches.
     """
     n_txns = len(txns)
     (too_old_l, r_begin, r_end, r_txn, r_snap, w_begin, w_end, w_txn) = (
         flatten_batch(txns, oldest_version)
     )
 
-    min_r, min_w, min_t = caps if caps is not None else (0, 0, 0)
+    if caps is None:
+        caps = (0, 0, 0, 0, 0)
+    elif len(caps) == 3:
+        caps = (*caps, 0, 0)
+    min_r, min_w, min_t, min_er, min_ew = caps
     nr, nw = len(r_begin), len(w_begin)
     R = next_bucket(max(nr, min_r))
     Wr = next_bucket(max(nw, min_w))
@@ -372,6 +479,10 @@ def pack_batch(
     words, lens = pack_keys(
         r_end + w_end + w_begin + r_begin, n_words
     )
+    if lens.size and int(lens.max()) >= LEN_PAD:
+        raise KeyWidthError(
+            f"key length {int(lens.max())} exceeds the len-field limit"
+        )
     tags = np.concatenate(
         [
             np.full(nr, TAG_RE, np.int32),
@@ -396,18 +507,61 @@ def pack_batch(
             else np.uint64(0)
         )
         pair_keys.append(hi | lo)
-    order = np.lexsort((lt,) + tuple(reversed(pair_keys)))
+    order = _sort_order(pair_keys, lt, P_act)
     inv = np.empty(P_act, np.int32)
     inv[order] = np.arange(P_act, dtype=np.int32)
 
-    lay = FusedLayout(n_words, P2, R, Wr, T)
+    # End-derivation modes per row: ship only non-derivable end keys.
+    re_w, we_w = words[:nr], words[nr : nr + nw]
+    wb_w, rb_w = words[nr + nw : nr + 2 * nw], words[nr + 2 * nw :]
+    re_l, we_l = lens[:nr], lens[nr : nr + nw]
+    wb_l, rb_l = lens[nr + nw : nr + 2 * nw], lens[nr + 2 * nw :]
+
+    def end_modes(bw, bl, ew, el):
+        if len(bl) == 0:
+            return np.zeros(0, np.int32)
+        same = (bw == ew).all(axis=1)
+        keyafter = same & (el == bl + 1)
+        incw, ovf = incr_packed_keys(bw)
+        increment = (
+            ~keyafter & ~ovf & (el == bl) & (incw == ew).all(axis=1)
+        )
+        return np.where(
+            keyafter, MODE_KEYAFTER,
+            np.where(increment, MODE_INCREMENT, MODE_EXPLICIT),
+        ).astype(np.int32)
+
+    mode_r = end_modes(rb_w, rb_l, re_w, re_l)
+    mode_w = end_modes(wb_w, wb_l, we_w, we_l)
+    expl_r = mode_r == MODE_EXPLICIT
+    expl_w = mode_w == MODE_EXPLICIT
+    n_er, n_ew = int(expl_r.sum()), int(expl_w.sum())
+    Er = next_bucket(n_er) if max(n_er, min_er) else 0
+    Er = max(Er, min_er)
+    Ew = next_bucket(n_ew) if max(n_ew, min_ew) else 0
+    Ew = max(Ew, min_ew)
+
+    lay = FusedLayout(n_words, P2, R, Wr, T, Er, Ew)
     buf = np.zeros(lay.total, dtype=np.int32)
     W1 = n_words + 1
-    smat = buf[lay.off_smat : lay.off_smat + W1 * P2].reshape(W1, P2)
-    smat[:n_words, :] = PAD_WORD
-    smat[n_words, :] = INT32_MAX
-    smat[:n_words, :P_act] = words[order].T
-    smat[n_words, :P_act] = lens[order]
+
+    def fill_keys(off, pad_to, w, l, modebits=None):
+        m = buf[off : off + W1 * pad_to].reshape(W1, pad_to)
+        m[:n_words, :] = PAD_WORD
+        m[n_words, :] = LEN_PAD
+        cnt = len(l)
+        if cnt:
+            m[:n_words, :cnt] = w.T
+            m[n_words, :cnt] = (
+                l if modebits is None else l | (modebits << 14)
+            )
+
+    fill_keys(lay.off_rb, R, rb_w, rb_l, mode_r)
+    fill_keys(lay.off_wb, Wr, wb_w, wb_l, mode_w)
+    if Er:
+        fill_keys(lay.off_re_ext, Er, re_w[expl_r], re_l[expl_r])
+    if Ew:
+        fill_keys(lay.off_we_ext, Ew, we_w[expl_w], we_l[expl_w])
 
     # Pad endpoint positions: the tag-ordered blocks right after P_act —
     # exactly where the full padded lexsort used to place them.
@@ -428,24 +582,43 @@ def pack_batch(
         P_act + pr + 2 * pw_ + ar(pr, dtype=np.int32)
     )
 
-    rtxn = buf[lay.off_rtxn : lay.off_rtxn + R]
-    rtxn[:nr] = r_txn
-    rsnap = buf[lay.off_rsnap : lay.off_rsnap + R]
-    rsnap[:] = INT32_MAX
-    if nr:
-        rel_snap = np.asarray(r_snap, dtype=np.int64) - oldest_version
-        if rel_snap.min() < 0 or rel_snap.max() >= 2**31:
-            raise ValueError(
-                "read snapshot outside the int32 window relative to "
-                f"oldest_version={oldest_version}"
-            )
-        rsnap[:nr] = rel_snap.astype(np.int32)
-    wtxn = buf[lay.off_wtxn : lay.off_wtxn + Wr]
-    wtxn[:nw] = w_txn
-    buf[lay.off_w_valid : lay.off_w_valid + nw] = 1
-    buf[lay.off_too_old : lay.off_too_old + n_txns] = too_old_l
+    # Per-txn metadata: row counts, tooOld flag, snapshot offset.
+    rcount = np.bincount(
+        np.asarray(r_txn, dtype=np.int64), minlength=T
+    ).astype(np.int64) if nr else np.zeros(T, np.int64)
+    wcount = np.bincount(
+        np.asarray(w_txn, dtype=np.int64), minlength=T
+    ).astype(np.int64) if nw else np.zeros(T, np.int64)
+    if rcount.max(initial=0) > 0x1FFF or wcount.max(initial=0) > 0x1FFF:
+        raise ValueError(
+            "a transaction exceeds 8191 conflict ranges of one kind "
+            "(chunk the batch; see SERVER_KNOBS.TPU_MAX_CHUNK_RANGES)"
+        )
+    too_old_arr = np.zeros(T, np.int64)
+    too_old_arr[:n_txns] = np.asarray(too_old_l, dtype=np.int64)
+    buf[lay.off_tmeta : lay.off_tmeta + T] = (
+        rcount | (wcount << 13) | (too_old_arr << 26)
+    ).astype(np.int32)
+    if n_txns:
+        snaps = np.fromiter(
+            (t.read_snapshot for t in txns), dtype=np.int64, count=n_txns
+        )
+        live_reads = (~too_old_arr[:n_txns].astype(bool)) & (rcount[:n_txns] > 0)
+        rel = snaps - oldest_version
+        if live_reads.any():
+            lr = rel[live_reads]
+            if lr.min() < 0 or lr.max() >= 2**31:
+                raise ValueError(
+                    "read snapshot outside the int32 window relative to "
+                    f"oldest_version={oldest_version}"
+                )
+        buf[lay.off_tsnap : lay.off_tsnap + n_txns] = np.where(
+            live_reads, rel, 0
+        ).astype(np.int32)
+    buf[lay.off_scalars + 2] = nr
+    buf[lay.off_scalars + 3] = nw
 
     return PackedBatch(
         n_txns=n_txns, layout=lay, buf=buf, base=oldest_version,
-        n_reads=nr, n_writes=nw,
+        n_reads=nr, n_writes=nw, n_expl_r=n_er, n_expl_w=n_ew,
     )

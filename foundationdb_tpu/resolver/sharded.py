@@ -265,9 +265,12 @@ class ShardedConflictSetTPU:
         # ConflictSetTPU.pack): per-shard live row counts jitter (clipping
         # + too_old waves), and re-bucketing means an XLA compile per batch
         # on the commit path.
-        r_cap, w_cap, t_bucket = self._sticky.caps_for(len(txns))
+        r_cap, w_cap, t_bucket, er_cap, ew_cap = self._sticky.caps_for(
+            len(txns)
+        )
         caps = (
-            max(max(counts_r), r_cap), max(max(counts_w), w_cap), t_bucket
+            max(max(counts_r), r_cap), max(max(counts_w), w_cap), t_bucket,
+            er_cap, ew_cap,
         )
         max_writes = max(counts_w)
 
@@ -277,6 +280,23 @@ class ShardedConflictSetTPU:
                     pack_batch(local, self.oldest_version, self.n_words, caps)
                     for local in per_shard
                 ]
+                # Shards must share ONE layout (the stacked tensors shard
+                # evenly over the mesh) but explicit-end counts are only
+                # known after packing: repack against the widest shard's
+                # buckets if they diverged (rare — sticky caps absorb it
+                # from the second batch on).
+                if len({pb.layout.key() for pb in packed}) > 1:
+                    caps = (
+                        caps[0], caps[1], caps[2],
+                        max(pb.layout.Er for pb in packed),
+                        max(pb.layout.Ew for pb in packed),
+                    )
+                    packed = [
+                        pack_batch(
+                            local, self.oldest_version, self.n_words, caps
+                        )
+                        for local in per_shard
+                    ]
                 break
             except KeyWidthError:
                 longest = max(
@@ -290,6 +310,8 @@ class ShardedConflictSetTPU:
         self._sticky.update_counts(
             lay, max(p.n_reads for p in packed),
             max(p.n_writes for p in packed),
+            max(p.n_expl_r for p in packed),
+            max(p.n_expl_w for p in packed),
         )
         for pb in packed:
             pb.set_scalars(version_off, oldest_off)

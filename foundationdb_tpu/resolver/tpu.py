@@ -185,22 +185,110 @@ def _resolve_kernel_impl(hmat, n, fused, *, lay: FusedLayout):
     P2, R, Wr, T = lay.P2, lay.R, lay.Wr, lay.T
     i32 = jnp.int32
 
-    # ---- unpack the fused buffer (static slices; one H2D behind us) ----
-    smat = lax.dynamic_slice_in_dim(fused, lay.off_smat, (W + 1) * P2).reshape(
-        W + 1, P2
-    )
+    # ---- unpack + DECODE the compact fused buffer (packing.FusedLayout):
+    # the H2D ships begin keys, sorted positions and per-txn metadata; the
+    # sorted endpoint matrix, per-row txn ids/snapshots and write validity
+    # are reconstructed here (a dozen device ops trade for ~half the
+    # transfer bytes — on the measured link, bytes are latency). ----
+    from .packing import MODE_EXPLICIT, MODE_INCREMENT
+
+    W1 = W + 1
     sl = lambda off, size: lax.dynamic_slice_in_dim(fused, off, size)
+    rbk = sl(lay.off_rb, W1 * R).reshape(W1, R)
+    wbk = sl(lay.off_wb, W1 * Wr).reshape(W1, Wr)
     q_begin = sl(lay.off_q_begin, R)
     q_end = sl(lay.off_q_end, R)
     s_begin = sl(lay.off_s_begin, Wr)
     s_end = sl(lay.off_s_end, Wr)
-    rtxn = sl(lay.off_rtxn, R)
-    rsnap = sl(lay.off_rsnap, R)
-    wtxn = sl(lay.off_wtxn, Wr)
-    w_valid = sl(lay.off_w_valid, Wr).astype(bool)
-    too_old = sl(lay.off_too_old, T).astype(bool)
+    tmeta = sl(lay.off_tmeta, T)
+    tsnap = sl(lay.off_tsnap, T)
     version = fused[lay.off_scalars]
     oldest_eff = fused[lay.off_scalars + 1]
+    nr = fused[lay.off_scalars + 2]
+    nw = fused[lay.off_scalars + 3]
+
+    def decode_cols(bk, ext, n_ext):
+        """(begin, end) key columns (W1, count) of one row segment: pad
+        sentinel -> +inf keys; ends derived per the mode bits (keyAfter /
+        integer increment / explicit side table)."""
+        count = bk.shape[1]
+        lenf = bk[W]
+        ln = lenf & 0x3FFF
+        mode = lenf >> 14
+        is_pad = ln == 0x3FFF
+        bcol = jnp.concatenate(
+            [bk[:W], jnp.where(is_pad, _I32_INF, ln)[None]], axis=0
+        )
+        # Integer increment: +1 with carry from the last word (biased
+        # int32 wraps exactly like the raw unsigned word).
+        inc_rows = []
+        carry = jnp.ones(count, dtype=bool)
+        for j in range(W - 1, -1, -1):
+            inc_rows.append(bk[j] + carry.astype(i32))
+            carry = carry & (bk[j] == _I32_INF)
+        inc = jnp.stack(inc_rows[::-1])
+        is_inc = (mode == MODE_INCREMENT)[None, :]
+        ewords = jnp.where(is_inc, inc, bk[:W])
+        elen = jnp.where(mode == MODE_INCREMENT, ln, ln + 1)
+        if n_ext:
+            is_ex = mode == MODE_EXPLICIT
+            eidx = jnp.cumsum(is_ex.astype(i32)) - is_ex
+            ecols = ext[:, jnp.clip(eidx, 0, n_ext - 1)]
+            ewords = jnp.where(is_ex[None, :], ecols[:W], ewords)
+            elen = jnp.where(is_ex, ecols[W] & 0x3FFF, elen)
+        ecol = jnp.concatenate(
+            [
+                jnp.where(is_pad[None, :], jnp.int32(PAD_WORD), ewords),
+                jnp.where(is_pad, _I32_INF, elen)[None],
+            ],
+            axis=0,
+        )
+        return bcol, ecol
+
+    re_ext = (
+        sl(lay.off_re_ext, W1 * lay.Er).reshape(W1, lay.Er)
+        if lay.Er else None
+    )
+    we_ext = (
+        sl(lay.off_we_ext, W1 * lay.Ew).reshape(W1, lay.Ew)
+        if lay.Ew else None
+    )
+    rb_col, re_col = decode_cols(rbk, re_ext, lay.Er)
+    wb_col, we_col = decode_cols(wbk, we_ext, lay.Ew)
+
+    # Sorted endpoint matrix: every sorted slot holds exactly one endpoint
+    # (pads included, at their arithmetic positions), so four unique-index
+    # column scatters rebuild what the fat layout used to ship.
+    smat = (
+        jnp.concatenate(
+            [
+                jnp.full((W, P2), PAD_WORD, dtype=i32),
+                jnp.full((1, P2), _I32_INF, dtype=i32),
+            ]
+        )
+        .at[:, q_begin].set(rb_col)
+        .at[:, q_end].set(re_col)
+        .at[:, s_begin].set(wb_col)
+        .at[:, s_end].set(we_col)
+    )
+
+    # Per-row txn ids from per-txn counts; rows outside the live prefix
+    # resolve to harmless values (snapshot +inf, validity False).
+    rcount = tmeta & 0x1FFF
+    wcount = (tmeta >> 13) & 0x1FFF
+    too_old = ((tmeta >> 26) & 1).astype(bool)
+
+    def row_txn(counts, size):
+        starts = jnp.cumsum(counts) - counts
+        marks = jnp.zeros(size + 1, dtype=i32).at[starts].add(1)
+        return jnp.clip(jnp.cumsum(marks[:size]) - 1, 0, T - 1)
+
+    rtxn = row_txn(rcount, R)
+    wtxn = row_txn(wcount, Wr)
+    rsnap = jnp.where(
+        jnp.arange(R, dtype=i32) < nr, tsnap[rtxn], _I32_INF
+    )
+    w_valid = jnp.arange(Wr, dtype=i32) < nw
 
     hkeys = hmat[: W + 1]
     hv = hmat[W + 1]
@@ -547,10 +635,18 @@ class ConflictSetTPU:
         init_version: int = 0,
         max_key_bytes: int = 32,
         initial_capacity: int = 1024,
+        min_capacity: int = 64,
     ):
         self.n_words = max(1, (max_key_bytes + 3) // 4)
         self.max_key_bytes = 4 * self.n_words
         self.capacity = next_pow2(initial_capacity, minimum=64)
+        # Shrink floor: a deployment that sized its history deliberately
+        # (min_capacity == initial_capacity) never pays resize recompiles;
+        # the default floor lets GC-windowed workloads shed capacity they
+        # no longer use.
+        self.min_capacity = min(
+            next_pow2(min_capacity, minimum=64), self.capacity
+        )
         self.oldest_version = 0  # absolute; also the version-offset base
         if not (0 <= init_version < 2**31):
             raise ValueError("init_version must fit the initial int32 window")
@@ -658,9 +754,22 @@ class ConflictSetTPU:
         if pb.layout.n_words != self.n_words:
             raise ValueError("batch packed with a different key width")
 
-        # Pre-grow from the pessimistic bound so overflow cannot happen.
-        if self._n_bound + 2 * pb.n_writes >= self.capacity:
-            self._grow(self._n_bound + 2 * pb.n_writes + 1)
+        # Pre-grow from the pessimistic bound so overflow cannot happen;
+        # SHRINK (with 4x hysteresis) when GC has collapsed the history —
+        # every history-scaled kernel pass costs proportional device time,
+        # so a sliding-window steady state at n << capacity would otherwise
+        # pay for entries it no longer holds. Either resize is a bounded
+        # number of recompiles (pow2 capacities).
+        need = self._n_bound + 2 * pb.n_writes
+        if need >= self.capacity:
+            self._grow(need + 1)
+        else:
+            new_cap = max(
+                next_pow2(need + 1, minimum=64) * 2, self.min_capacity
+            )
+            if new_cap * 2 <= self.capacity:
+                self.hmat = self.hmat[:, :new_cap]
+                self.capacity = new_cap
 
         pb.set_scalars(version_off, oldest_eff - self.oldest_version)
         # The numpy buffer goes straight into the jitted call: the backend

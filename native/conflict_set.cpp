@@ -512,6 +512,49 @@ int64_t fdbcs_entries(void* h, uint8_t* key_buf, int64_t* offs, int32_t* lens,
     return n;
 }
 
+// Stable LSD radix sort for the HOST packer (resolver/packing.py): order
+// of n endpoints by (key64, lt32) — the composite (packed key words, len,
+// tag) sort the TPU batch layout needs. 6x16-bit counting passes; ~10x
+// the speed of np.lexsort at ~1M rows. Scratch is malloc'd per call (the
+// packer calls this once per batch).
+int32_t fdbcs_sort_order(const uint64_t* key, const uint32_t* lt, int32_t n,
+                         int32_t* order_out) {
+    if (n <= 0) return 0;
+    std::vector<uint32_t> a(n), b(n), cnt(1 << 16);
+    for (int32_t i = 0; i < n; i++) a[i] = (uint32_t)i;
+    uint32_t* src = a.data();
+    uint32_t* dst = b.data();
+    for (int pass = 0; pass < 6; pass++) {
+        int shift = 16 * (pass < 2 ? pass : pass - 2);
+        bool on_key = pass >= 2;
+        memset(cnt.data(), 0, sizeof(uint32_t) << 16);
+        if (on_key)
+            for (int32_t i = 0; i < n; i++)
+                cnt[(key[src[i]] >> shift) & 0xffff]++;
+        else
+            for (int32_t i = 0; i < n; i++)
+                cnt[(lt[src[i]] >> shift) & 0xffff]++;
+        uint32_t first_digit = on_key ? ((key[src[0]] >> shift) & 0xffff)
+                                      : ((lt[src[0]] >> shift) & 0xffff);
+        if (cnt[first_digit] == (uint32_t)n) continue;  // constant digit
+        uint32_t sum = 0;
+        for (int d = 0; d < (1 << 16); d++) {
+            uint32_t c = cnt[d];
+            cnt[d] = sum;
+            sum += c;
+        }
+        if (on_key)
+            for (int32_t i = 0; i < n; i++)
+                dst[cnt[(key[src[i]] >> shift) & 0xffff]++] = src[i];
+        else
+            for (int32_t i = 0; i < n; i++)
+                dst[cnt[(lt[src[i]] >> shift) & 0xffff]++] = src[i];
+        std::swap(src, dst);
+    }
+    for (int32_t i = 0; i < n; i++) order_out[i] = (int32_t)src[i];
+    return 0;
+}
+
 // Resolve one batch. Reads/writes are flattened across txns IN TXN ORDER
 // (r_txn / w_txn non-decreasing); ranges of tooOld txns must have been
 // dropped by the caller (mirroring flatten_batch's admission rules), and
