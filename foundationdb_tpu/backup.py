@@ -58,8 +58,33 @@ async def _write_snapshot(out, tr, version: int, begin: bytes, end: bytes,
     rows = 0
     cursor = begin
     while True:
-        chunk = await tr.get_range(cursor, end, limit=chunk_rows,
-                                   snapshot=True)
+        # Snapshot reads at a fixed version are idempotent: transient
+        # LINK failures retry rather than aborting a long backup (the
+        # reference's backup tasks retry their range reads the same way).
+        # transaction_too_old is NOT retried here — the snapshot version
+        # has aged out of the MVCC window and only a fresh backup (new
+        # version) can make progress; retrying the same version would spin
+        # forever.
+        while True:
+            try:
+                chunk = await tr.get_range(cursor, end, limit=chunk_rows,
+                                           snapshot=True)
+                break
+            except BaseException as e:  # noqa: BLE001
+                from .core.errors import (
+                    BrokenPromise,
+                    ConnectionFailed,
+                    RequestMaybeDelivered,
+                    TimedOut,
+                )
+
+                if not isinstance(e, (RequestMaybeDelivered,
+                                      ConnectionFailed, BrokenPromise,
+                                      TimedOut)):
+                    raise
+                from .core.runtime import current_loop
+
+                await current_loop().delay(0.1)
         for k, v in chunk:
             _write_rec(out, k, v)
             rows += 1

@@ -48,6 +48,8 @@ class ClusterConnection:
         role as a signal to re-resolve and retry, NativeAPI throughout)."""
         from ..core.errors import BrokenPromise, ConnectionFailed
 
+        from ..core.runtime import buggify
+
         loop = current_loop()
         backoff = CLIENT_KNOBS.DEFAULT_BACKOFF
         while True:
@@ -58,6 +60,11 @@ class ClusterConnection:
                     req.reply.future, request_timeout, _LOST
                 )
             except (ConnectionFailed, BrokenPromise):
+                result = _LOST
+            if result is not _LOST and buggify("client_reply_dropped", 0.1):
+                # The reply made it but the client behaves as if it were
+                # lost (timer raced the delivery): idempotent requests
+                # must tolerate the duplicate re-send.
                 result = _LOST
             if result is not _LOST:
                 return result

@@ -190,6 +190,11 @@ class Transaction:
         """GRV; batched proxy-side (ref: readVersionBatcher :2700).
         Priority options map onto the request's priority band."""
         self._check_usable()
+        return self._read_version_internal()
+
+    def _read_version_internal(self) -> Future:
+        """GRV issuance without the usability check — the commit body
+        acquires its snapshot AFTER the committing flag is set."""
         if self._read_version_f is None:
             from ..cluster.interfaces import GetReadVersionRequest as GRV
             from ..options import TransactionOptions as TO
@@ -432,11 +437,23 @@ class Transaction:
     def get_versionstamp(self) -> "Future":
         """Future of the 10-byte stamp this transaction's versionstamped
         operations used; resolves after commit (ref:
-        Transaction::getVersionstamp, NativeAPI.actor.cpp)."""
+        Transaction::getVersionstamp, NativeAPI.actor.cpp). Requested
+        AFTER the commit already resolved, it answers immediately — a
+        promise registered post-commit would otherwise never be fed (a
+        read-only commit has no stamp: no_commit_version)."""
         from ..core.runtime import Promise
 
         p = Promise()
-        self._versionstamp_promises.append(p)
+        if self._committed_version is not None:
+            stamp = getattr(self, "_versionstamp", None)
+            if stamp is not None:
+                p.send(stamp)
+            else:
+                from ..core.errors import NoCommitVersion
+
+                p.send_error(NoCommitVersion())
+        else:
+            self._versionstamp_promises.append(p)
         return p.future
 
     # -- conflict ranges (ref: tr.add_read/write_conflict_range) --
@@ -468,13 +485,30 @@ class Transaction:
         return w
 
     # -- commit / retry --
-    async def commit(self) -> int:
-        """Resolves with the commit version; raises NotCommitted on
-        conflict (ref: Transaction::commit :2571)."""
+    def commit(self):
+        """Awaitable of the commit version; raises NotCommitted on
+        conflict (ref: Transaction::commit :2571). The committing flag is
+        set at CALL time, exactly like the reference's commit actor
+        running to its first wait synchronously: any use of the
+        transaction after commit() was invoked — even before the returned
+        awaitable first runs — is used_during_commit, deterministically."""
         self._check_usable()
         self._check_deadline()
         if self._committed_version is not None:
-            return self._committed_version
+            async def _already() -> int:
+                return self._committed_version
+
+            return _already()
+        self._commit_outstanding = True
+        return self._commit_impl()
+
+    async def _commit_impl(self) -> int:
+        try:
+            return await self._commit_body()
+        finally:
+            self._commit_outstanding = False
+
+    async def _commit_body(self) -> int:
         if not self._mutation_log and not self._extra_write_conflicts:
             # Read-only transactions commit trivially at their snapshot
             # (ref: tryCommit fast path). A read-only commit has no
@@ -483,6 +517,7 @@ class Transaction:
             if self._read_version_f is not None:
                 rv = await self._read_version_f
             self._committed_version = rv
+            self._commit_outstanding = False  # outcome known: see below
             from ..core.errors import NoCommitVersion
 
             for p in self._versionstamp_promises:
@@ -492,19 +527,19 @@ class Transaction:
             return rv
         snapshot = 0
         if self._read_conflicts:
-            snapshot = await self.get_read_version()
+            snapshot = await self._read_version_internal()
         req = CommitTransactionRequest(
             read_snapshot=snapshot,
             read_conflict_ranges=tuple(self._read_conflicts),
             write_conflict_ranges=tuple(self._extra_write_conflicts),
             mutations=tuple(self._mutation_log),
         )
-        self._commit_outstanding = True
-        try:
-            commit_id = await self._db.conn.commit(req)
-        finally:
-            self._commit_outstanding = False
+        commit_id = await self._db.conn.commit(req)
         self._committed_version = commit_id.version
+        self._versionstamp = commit_id.versionstamp
+        # Outcome known: the transaction leaves the committing state BEFORE
+        # watch arming (which reads through this transaction's own API).
+        self._commit_outstanding = False
         for p in self._versionstamp_promises:
             if not p.is_set():
                 p.send(commit_id.versionstamp)
@@ -538,6 +573,12 @@ class Transaction:
         loop = current_loop()
         backoff = self._backoff
         self._reset_for_retry(backoff)
+        from ..core.runtime import buggify
+
+        if buggify("client_retry_storm"):
+            backoff = 0.0  # immediate retry: contention amplification
+        elif buggify("client_retry_stall"):
+            backoff *= 8  # a straggling retry lands long after its peers
         await loop.delay(backoff * (0.5 + loop.random.random01()))
 
     def _reset_for_retry(self, prev_backoff: float) -> None:

@@ -27,6 +27,8 @@ async def batcher(
 ):
     """Forever: gather a batch and hand it to on_batch (which typically
     spawns the per-batch actor so batching continues concurrently)."""
+    from ..core.runtime import buggify
+
     loop = current_loop()
     sentinel = object()
     while True:
@@ -34,6 +36,10 @@ async def batcher(
         batch = [first]
         size = bytes_of(first)
         deadline = loop.now() + interval
+        if buggify("batcher_tiny_batches"):
+            deadline = loop.now()  # close immediately: 1-item batches
+        elif buggify("batcher_slow_flush"):
+            deadline += interval * 4  # stragglers pile into one batch
         while size < max_bytes and len(batch) < max_count:
             remaining = deadline - loop.now()
             if remaining <= 0:

@@ -59,14 +59,18 @@ class CoordinatorRegister:
         return s
 
     def read(self, key: str, gen: int) -> tuple[Any, int]:
-        if not self.available:
+        from ..core.runtime import buggify
+
+        if not self.available or buggify("coordinator_read_blip", 0.05):
             raise OperationFailed(f"coordinator {self.name} unavailable")
         s = self._reg(key)
         s.read_gen = max(s.read_gen, gen)
         return s.value, s.write_gen
 
     def write(self, key: str, gen: int, value: Any) -> bool:
-        if not self.available:
+        from ..core.runtime import buggify
+
+        if not self.available or buggify("coordinator_write_blip", 0.05):
             raise OperationFailed(f"coordinator {self.name} unavailable")
         s = self._reg(key)
         if gen < s.read_gen or gen < s.write_gen:

@@ -65,6 +65,12 @@ async def move_keys(cluster, r: KeyRange, new_team: Sequence[int],
     new_team = tuple(sorted(new_team))
     if lock is not None:
         await lock.acquire()
+    from ..core.runtime import buggify, current_loop
+
+    if buggify("movekeys_slow_start"):
+        # The union-team window stays open longer: concurrent commits and
+        # reads must stay correct while both teams serve the range.
+        await current_loop().delay(0.1 * current_loop().random.random01())
     try:
         # Capture the pre-move layout: snapshots must come from each
         # SLICE's own team (a range can span shards with different teams).
@@ -123,6 +129,12 @@ async def _move_keys_fetch_finish(cluster, r, new_team, old_slices,
 
     # -- fetch: wait dests onto the stream, then snapshot each slice
     #    at v_f from a surviving member of ITS old team --
+    from ..core.runtime import buggify, current_loop
+
+    if buggify("movekeys_slow_fetch"):
+        # The snapshot lags the fence: dests buffer a longer tail of the
+        # live stream before the base lands under it.
+        await current_loop().delay(0.1 * current_loop().random.random01())
     for t in dests:
         await cluster.storages[t].version.when_at_least(v_f)
     if dests:
@@ -295,6 +307,11 @@ class DataDistributor:
     async def _heal_one(self) -> None:
         """Replace failed members in one unhealthy shard (ref:
         teamTracker's zeroHealthyTeams/servers-left logic)."""
+        from ..core.runtime import buggify, current_loop
+
+        if buggify("dd_slow_heal"):
+            # Healing lags the failure: the shard serves degraded longer.
+            await current_loop().delay(0.2 * current_loop().random.random01())
         unplaceable = self._unplaceable()
         for b, e, team in self.cluster.shard_map.ranges():
             if not team:

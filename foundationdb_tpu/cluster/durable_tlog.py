@@ -210,6 +210,12 @@ class DurableTaggedTLog(TaggedTLog):
             if self.durable.get() >= target:
                 await self.version.when_at_least(target + 1)
                 continue
+            if buggify("tlog_group_fsync_delay"):
+                # A slow disk widens the group: more batches share one
+                # fsync and every committer waits longer.
+                await current_loop().delay(
+                    0.05 * current_loop().random.random01()
+                )
             self.queue.commit()  # the fsync
             self.entry_durable = max(self.entry_durable, target)
             if target > self.durable.get():

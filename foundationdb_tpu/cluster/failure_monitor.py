@@ -150,9 +150,19 @@ def heartbeater(server_stream, process_name: str, interval: float = None):
     async def run():
         from ..core.actors import timeout
 
+        from ..core.runtime import buggify
+
         loop = current_loop()
         ival = interval or SERVER_KNOBS.FAILURE_MIN_DELAY / 4
         while True:
+            if buggify("heartbeat_jitter"):
+                # A GC-pause-shaped gap just short of the failure window
+                # (beat interval + jitter stays under FAILURE_TIMEOUT_DELAY):
+                # detection must neither flap nor miss real deaths.
+                await loop.delay(
+                    (SERVER_KNOBS.FAILURE_TIMEOUT_DELAY - ival)
+                    * 0.8 * loop.random.random01()
+                )
             req = HeartbeatRequest(process_name)
             server_stream.send(req)
             # Reply is advisory; losing it just means beating again.

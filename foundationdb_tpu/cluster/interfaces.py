@@ -136,6 +136,11 @@ class ResolveTransactionBatchRequest:
     transactions: list  # list[TxnConflictInfo]
     system_mutations: tuple = ()
     committed_feedback: tuple = ()
+    # Generation fence for resolver HOSTS serving multiple generations
+    # over reused endpoints (multiprocess tier): a deposed proxy's
+    # in-flight batch must not merge into the successor's conflict state.
+    # In-process roles (one per generation by construction) ignore it.
+    epoch: int = 0
     reply: Promise = field(default_factory=Promise)
 
 

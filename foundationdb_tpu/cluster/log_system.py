@@ -108,11 +108,20 @@ class TagPartitionedLogSystem:
         # Every log gets every version (possibly empty) so every chain
         # advances; durability = all logs durable (the commit's fsync
         # quorum, ref: TLogCommitReply gathering in push).
-        from ..core.runtime import TaskPriority, spawn
+        from ..core.runtime import TaskPriority, buggify, current_loop, spawn
+
+        async def one(log, batch):
+            if buggify("log_push_stagger"):
+                # One replica's append lands late: the fsync quorum (and
+                # anything gating on durable_version) must wait it out.
+                await current_loop().delay(
+                    0.05 * current_loop().random.random01()
+                )
+            await log.commit(prev_version, version, batch, epoch=epoch)
 
         tasks = [
-            spawn(log.commit(prev_version, version, batch, epoch=epoch),
-                  TaskPriority.TLOG_COMMIT, name=f"logPush{i}")
+            spawn(one(log, batch), TaskPriority.TLOG_COMMIT,
+                  name=f"logPush{i}")
             for i, (log, batch) in enumerate(zip(self.logs, per_log))
         ]
         await all_of([t.done for t in tasks])

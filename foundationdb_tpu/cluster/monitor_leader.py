@@ -93,10 +93,15 @@ def monitor_cluster_interface(coordinators, refs: dict,
     monitorLeaderInternal's long-poll loop)."""
 
     async def run():
+        from ..core.runtime import buggify
+
         loop = current_loop()
         cs = CoordinatedState(coordinators, key=INTERFACE_KEY)
         known = -1
         while True:
+            if buggify("monitor_leader_slow_discovery"):
+                # Clients keep retrying against stale endpoints meanwhile.
+                await loop.delay(0.5 * loop.random.random01())
             try:
                 info = cs.read(cs._fresh_gen())
             except OperationFailed:

@@ -55,8 +55,9 @@ from .log_system import TaggedMutation
 
 # -- well-known tokens (extending net/service.py's client-facing trio) --
 WLTOKEN_LOCATION = 13
-WLTOKEN_LOG_BASE = 100      # +2*i commit, +2*i+1 control
-WLTOKEN_STORAGE_BASE = 300  # +2*tag read, +2*tag+1 control
+WLTOKEN_LOG_BASE = 100       # +2*i commit, +2*i+1 control
+WLTOKEN_STORAGE_BASE = 300   # +2*tag read, +2*tag+1 control
+WLTOKEN_RESOLVER_BASE = 500  # host control; +1+idx per-resolver resolve
 
 
 # -- wire messages for the role-to-role hops --
@@ -103,6 +104,59 @@ class TLogSkipToRequest:
 
 
 @dataclass
+class InitResolversRequest:
+    """Recovery -> resolver host: recruit a fresh per-generation resolver
+    fleet at the recovery version (ref: the master's InitializeResolver
+    dispatch; resolver state is per-generation by design)."""
+
+    generation: int
+    start_version: int
+    reply: Promise = field(default_factory=Promise)
+
+
+@dataclass
+class ResolverSkipWindowRequest:
+    """Proxy failure-path compensation over the wire (ResolverRole.
+    skip_window: advance the version chain past a failed batch). Carries
+    the generation fence like the resolve stream."""
+
+    idx: int
+    prev_version: int
+    version: int
+    epoch: int = 0
+    reply: Promise = field(default_factory=Promise)
+
+
+@dataclass
+class ResolverStatusRequest:
+    """Balancer input: (keys_resolved, key sample) of one resolver (ref:
+    ResolutionMetricsRequest / key-load samples, Resolver.actor.cpp:
+    148-152)."""
+
+    idx: int
+    reply: Promise = field(default_factory=Promise)
+
+
+@dataclass
+class ResolveBatchReply:
+    """Wire form of a resolve verdict: per-txn statuses + the catch-up
+    state payload (Resolver.actor.cpp:171-190) lifted into the reply."""
+
+    statuses: tuple
+    state_mutations: tuple = ()
+
+
+@dataclass
+class TLogHostDurableRequest:
+    """Host-level durability floor: min entry-durable across the LOGS THIS
+    HOST SERVES. Storage hosts combine the per-host floors into the system
+    flush horizon (every per-host value is a true past value of a monotone
+    quantity, so the min over hosts is always a safe lower bound)."""
+
+    reply: Promise = field(default_factory=Promise)
+
+
+@dataclass
 class TLogConfirmEpochRequest:
     """GRV epoch-liveness probe (ref: confirmEpochLive,
     TagPartitionedLogSystem.actor.cpp:553). Replies with the log's locked
@@ -136,7 +190,9 @@ class StorageStatusRequest:
 for _cls in (
     TLogPeekRequest, TLogPopRequest, TLogLockRequest, TLogTruncateRequest,
     TLogSkipToRequest, TLogStatusRequest, TLogConfirmEpochRequest,
-    StorageRollbackRequest, StorageStatusRequest, TaggedMutation,
+    TLogHostDurableRequest, StorageRollbackRequest, StorageStatusRequest,
+    TaggedMutation, InitResolversRequest, ResolverSkipWindowRequest,
+    ResolverStatusRequest, ResolveBatchReply,
 ):
     register_message(_cls)
 
@@ -175,6 +231,8 @@ def _spec_kw(spec: dict) -> dict:
     return dict(
         n_storage=spec.get("n_storage", 4),
         n_logs=spec.get("n_logs", 2),
+        n_log_hosts=spec.get("n_log_hosts", 1),
+        n_resolvers=spec.get("n_resolvers", 1),
         replication=spec.get("replication", "double"),
         shard_boundaries=[
             b.encode() if isinstance(b, str) else b
@@ -184,22 +242,49 @@ def _spec_kw(spec: dict) -> dict:
     )
 
 
+def log_host_classes(n_log_hosts: int) -> list[str]:
+    """Cluster-file keys / process-class names of the log hosts. A single
+    host keeps the historical plain "log" name."""
+    if n_log_hosts <= 1:
+        return ["log"]
+    return [f"log{j}" for j in range(n_log_hosts)]
+
+
+def log_owner(log_id: int, n_log_hosts: int) -> int:
+    """Which log host serves log `log_id` (round-robin across failure
+    domains — the reference places tlog replicas across machines,
+    TagPartitionedLogSystem.actor.cpp:339)."""
+    return log_id % max(1, n_log_hosts)
+
+
 # ---------------------------------------------------------------------------
 # log host
 # ---------------------------------------------------------------------------
 class LogHost:
-    """Serves every tlog of the deployment (v1: one log process owns the
-    whole quorum, so system-level durability is computable locally)."""
+    """Serves the subset of the deployment's tlogs owned by one failure
+    domain (host `host_index` of `n_log_hosts`; ref: the reference places
+    tlog replicas across machines and computes durability across them,
+    TagPartitionedLogSystem.actor.cpp:339). With one host the subset is
+    the whole quorum (the historical v1 topology)."""
 
-    def __init__(self, transport, datadir: str, n_logs: int):
+    LONG_POLL_S = 10.0  # bound parked peeks so dead clients cannot leak
+
+    def __init__(self, transport, datadir: str, n_logs: int,
+                 host_index: int = 0, n_log_hosts: int = 1):
         from .durable_tlog import DurableTaggedTLog
 
         os.makedirs(datadir, exist_ok=True)
-        self.logs = [
-            DurableTaggedTLog(f"{datadir}/log{i}") for i in range(n_logs)
+        self.owned = [
+            i for i in range(n_logs)
+            if log_owner(i, n_log_hosts) == host_index
         ]
+        # Datadir names follow the GLOBAL log id: a host restarted with a
+        # different index must not adopt another log's disk.
+        self.logs = {
+            i: DurableTaggedTLog(f"{datadir}/log{i}") for i in self.owned
+        }
         self._tasks = ActorCollection()
-        for i, log in enumerate(self.logs):
+        for i, log in self.logs.items():
             commit_stream: PromiseStream = PromiseStream()
             ctrl_stream: PromiseStream = PromiseStream()
             transport.register_endpoint(commit_stream,
@@ -224,7 +309,17 @@ class LogHost:
 
     async def _control(self, log, req):
         if isinstance(req, TLogPeekRequest):
-            entries = await log.peek_tag(req.tag, req.from_version)
+            # LONG POLL (ref: tLogPeekMessages blocks until messages
+            # arrive, TLogServer.actor.cpp:903): the reply parks until the
+            # tag has durable data, bounded so a vanished peer cannot leak
+            # a parked handler forever; an empty timeout reply tells the
+            # client to re-arm immediately.
+            t = spawn(log.peek_tag(req.tag, req.from_version),
+                      TaskPriority.TLOG_COMMIT, name="peekLongPoll")
+            entries = await timeout(t.done, self.LONG_POLL_S, _LOST)
+            if entries is _LOST:
+                t.cancel()
+                entries = []
             return (entries, self.durable_all())
         if isinstance(req, TLogPopRequest):
             log.pop_tag(req.tag, req.version)
@@ -246,37 +341,84 @@ class LogHost:
             return (log.version.get(), log.durable.get(), qbytes)
         if isinstance(req, TLogConfirmEpochRequest):
             return log.locked_epoch
+        if isinstance(req, TLogHostDurableRequest):
+            return self.durable_all()
         raise TypeError(f"unknown log request {type(req)}")
 
     def durable_all(self) -> int:
-        # entry_durable, not the raw durable cursor: see
-        # TagPartitionedLogSystem.durable_version — the awaited RPC gap
-        # between lock/truncate and the storage rollbacks makes the
+        # entry_durable of THIS HOST'S logs, not the raw durable cursor:
+        # see TagPartitionedLogSystem.durable_version — the awaited RPC
+        # gap between lock/truncate and the storage rollbacks makes the
         # distinction LOAD-BEARING here (a flush tick can fire inside it).
-        return min(log.quorum_durable() for log in self.logs)
+        # System-level durability = min over hosts, combined by the
+        # storage hosts' DurabilityTracker.
+        return min(log.quorum_durable() for log in self.logs.values())
 
     def stop(self) -> None:
         self._tasks.cancel_all()
-        for log in self.logs:
+        for log in self.logs.values():
             log.close()
 
 
 # ---------------------------------------------------------------------------
 # storage host
 # ---------------------------------------------------------------------------
+class DurabilityTracker:
+    """System flush horizon across N log hosts: latest known per-host
+    entry-durable floor, combined with min. Every cached value is a true
+    past value of a monotone per-host quantity, so the combined min is
+    always a SAFE lower bound — staleness only delays flushes, never
+    un-writes them. Peek replies feed the owning host's slot for free; a
+    background poller covers hosts this storage holds no tags on."""
+
+    def __init__(self, transport, log_addrs: list[str]):
+        self.n_hosts = len(log_addrs)
+        self._floor = [0] * self.n_hosts
+        # One control stream per host (its lowest-id owned log).
+        self._ctrl = [
+            transport.remote_stream(addr, WLTOKEN_LOG_BASE + 2 * j + 1)
+            for j, addr in enumerate(log_addrs)
+        ]
+
+    def feed(self, host: int, value: int) -> None:
+        self._floor[host] = max(self._floor[host], value)
+
+    def system_durable(self) -> int:
+        return min(self._floor)
+
+    def start_polling(self, tasks: ActorCollection) -> None:
+        async def poll():
+            loop = current_loop()
+            while True:
+                for j, ctrl in enumerate(self._ctrl):
+                    req = TLogHostDurableRequest()
+                    ctrl.send(req)
+                    got = await timeout(
+                        req.reply.future, SERVER_KNOBS.ROLE_RPC_TIMEOUT,
+                        _LOST,
+                    )
+                    if got is not _LOST:
+                        self.feed(j, got)
+                await loop.delay(SERVER_KNOBS.RATEKEEPER_UPDATE_INTERVAL)
+
+        tasks.add(spawn(poll(), TaskPriority.DEFAULT, name="durablePoll"))
+
+
 class RemoteTagView:
     """The storage server's log handle over TCP: same duck type as
-    TagView (peek/pop/quorum_durable). The quorum-durable horizon is
-    cached from peek replies — a LOWER BOUND is always safe (the horizon
-    is monotone), so the cache never blocks a flush incorrectly far."""
+    TagView (peek/pop/quorum_durable). Peeks are LONG-POLL: the server
+    parks the reply until the tag has data (bounded by its poll window),
+    so the idle cost is one parked request per tag, not a retry timer."""
 
-    def __init__(self, transport, log_addr: str, tag: int, n_logs: int):
+    def __init__(self, transport, log_addrs: list[str], tag: int,
+                 n_logs: int, tracker: DurabilityTracker):
         self.tag = tag
         i = tag % n_logs
+        self._host = log_owner(i, len(log_addrs))
         self._ctrl = transport.remote_stream(
-            log_addr, WLTOKEN_LOG_BASE + 2 * i + 1
+            log_addrs[self._host], WLTOKEN_LOG_BASE + 2 * i + 1
         )
-        self._durable_all = 0
+        self._tracker = tracker
 
     async def peek(self, from_version: int):
         loop = current_loop()
@@ -288,20 +430,21 @@ class RemoteTagView:
             except BaseException:  # noqa: BLE001 — conn loss: re-pull
                 await loop.delay(0.2)
                 continue
-            self._durable_all = max(self._durable_all, durable_all)
+            self._tracker.feed(self._host, durable_all)
             if entries:
                 return entries
-            await loop.delay(0.05)
+            # Empty reply == the server's long-poll window elapsed with no
+            # data for this tag: re-arm immediately (no client timer).
 
     def pop(self, upto_version: int) -> None:
         self._ctrl.send(TLogPopRequest(self.tag, upto_version))
 
     def quorum_durable(self) -> int:
-        return self._durable_all
+        return self._tracker.system_durable()
 
 
 class StorageHost:
-    def __init__(self, transport, datadir: str, spec: dict, log_addr: str):
+    def __init__(self, transport, datadir: str, spec: dict, log_addrs):
         from .sharded_cluster import (
             _all_false_map,
             _make_engine,
@@ -309,14 +452,19 @@ class StorageHost:
         )
         from .storage import StorageServer
 
+        if isinstance(log_addrs, str):
+            log_addrs = [log_addrs]
         os.makedirs(datadir, exist_ok=True)
         kw = _spec_kw(spec)
         layout = derive_layout(kw["n_storage"], kw["replication"],
                                kw["shard_boundaries"], kw["seed"])
         self.storages = []
         self._tasks = ActorCollection()
+        self.durability = DurabilityTracker(transport, log_addrs)
+        self.durability.start_polling(self._tasks)
         for tag in range(kw["n_storage"]):
-            view = RemoteTagView(transport, log_addr, tag, kw["n_logs"])
+            view = RemoteTagView(transport, log_addrs, tag, kw["n_logs"],
+                                 self.durability)
             eng = _make_engine(spec.get("engine", "memory"),
                                f"{datadir}/storage{tag}")
             s = StorageServer(view, 0, tag=tag, engine=eng)
@@ -356,6 +504,149 @@ class StorageHost:
 
 
 # ---------------------------------------------------------------------------
+# resolver host
+# ---------------------------------------------------------------------------
+class ResolverHost:
+    """One process hosting the resolver fleet (process class `resolver`):
+    per-generation ResolverRoles recruited by the recovery's
+    InitResolversRequest, each serving its resolve stream over the real
+    transport — the proxy's phase-2 fan-out and the master's balancing
+    samples ride RPC, as in the reference's separate resolver processes
+    (fdbserver/Resolver.actor.cpp)."""
+
+    def __init__(self, transport, spec: dict):
+        kw = _spec_kw(spec)
+        self.n_resolvers = kw["n_resolvers"]
+        self.generation = 0
+        self.roles: list = []
+        self._tasks = ActorCollection()
+        ctrl: PromiseStream = PromiseStream()
+        transport.register_endpoint(ctrl, WLTOKEN_RESOLVER_BASE)
+        self._tasks.add(serve_requests(
+            ctrl, self._control, TaskPriority.RESOLVER, "resolverCtrl",
+        ))
+        for i in range(self.n_resolvers):
+            s: PromiseStream = PromiseStream()
+            transport.register_endpoint(s, WLTOKEN_RESOLVER_BASE + 1 + i)
+            self._tasks.add(serve_requests(
+                s, lambda req, i=i: self._resolve(i, req),
+                TaskPriority.RESOLVER, f"resolve{i}",
+            ))
+
+    async def _control(self, req):
+        if isinstance(req, InitResolversRequest):
+            if req.generation < self.generation:
+                raise OperationFailed(
+                    f"init from old generation {req.generation} "
+                    f"(serving {self.generation})"
+                )
+            from ..resolver.cpu import ConflictSetCPU
+            from .resolver_role import ResolverRole
+
+            self.generation = req.generation
+            self.roles = [
+                ResolverRole(ConflictSetCPU(req.start_version),
+                             init_version=req.start_version)
+                for _ in range(self.n_resolvers)
+            ]
+            TraceEvent("ResolverHostRecruited").detail(
+                "Generation", req.generation
+            ).detail("StartVersion", req.start_version).detail(
+                "Count", self.n_resolvers
+            ).log()
+            return None
+        if isinstance(req, ResolverStatusRequest):
+            r = self.roles[req.idx]
+            return (r.keys_resolved, tuple(r.key_sample()))
+        if isinstance(req, ResolverSkipWindowRequest):
+            self._fence(req.epoch)
+            await self.roles[req.idx].skip_window(req.prev_version,
+                                                  req.version)
+            return None
+        raise TypeError(f"unknown resolver request {type(req)}")
+
+    def _fence(self, epoch: int) -> None:
+        """The resolve endpoints are reused across generations (unlike a
+        per-generation role object): a deposed proxy's in-flight batch
+        must not merge into the successor's conflict state (the tlog
+        carries the same fence on its commit stream)."""
+        if epoch < self.generation:
+            from ..core.errors import TLogStopped
+
+            raise TLogStopped(
+                f"resolver host serving generation {self.generation}; "
+                f"request from {epoch} refused"
+            )
+
+    async def _resolve(self, i, req):
+        if not self.roles:
+            raise OperationFailed("resolver host not recruited yet")
+        self._fence(getattr(req, "epoch", 0))
+        res = await self.roles[i].resolve_batch(req)
+        return ResolveBatchReply(
+            tuple(res.statuses),
+            tuple(getattr(res, "state_mutations", ())),
+        )
+
+    def stop(self) -> None:
+        self._tasks.cancel_all()
+
+
+class RemoteResolver:
+    """Txn-host-side handle to one remote resolver: the same duck type the
+    proxy's multi-resolver phase 2 and the ResolutionBalancer consume
+    (resolve_batch / skip_window / keys_resolved / key_sample), with the
+    hops as awaited RPCs and the balancer inputs cached from periodic
+    status pulls."""
+
+    def __init__(self, transport, addr: str, idx: int, generation: int = 0):
+        self.idx = idx
+        self.generation = generation
+        self._resolve_s = transport.remote_stream(
+            addr, WLTOKEN_RESOLVER_BASE + 1 + idx
+        )
+        self._ctrl = transport.remote_stream(addr, WLTOKEN_RESOLVER_BASE)
+        self.keys_resolved = 0
+        self._sample: tuple = ()
+
+    async def _rpc(self, stream, req):
+        stream.send(req)
+        got = await timeout(
+            req.reply.future, SERVER_KNOBS.ROLE_RPC_TIMEOUT, _LOST
+        )
+        if got is _LOST:
+            raise RequestMaybeDelivered(
+                f"{type(req).__name__} reply not received"
+            )
+        return got
+
+    async def resolve_batch(self, br):
+        from ..resolver.types import ConflictBatchResult
+
+        reply = await self._rpc(self._resolve_s, br)
+        out = ConflictBatchResult(list(reply.statuses))
+        out.state_mutations = reply.state_mutations
+        return out
+
+    async def skip_window(self, prev_version: int, version: int) -> None:
+        await self._rpc(
+            self._ctrl,
+            ResolverSkipWindowRequest(self.idx, prev_version, version,
+                                      epoch=self.generation),
+        )
+
+    async def refresh_status(self) -> None:
+        kr, sample = await self._rpc(
+            self._ctrl, ResolverStatusRequest(self.idx)
+        )
+        self.keys_resolved = kr
+        self._sample = sample
+
+    def key_sample(self) -> list:
+        return list(self._sample)
+
+
+# ---------------------------------------------------------------------------
 # txn host
 # ---------------------------------------------------------------------------
 class RemoteLogSystem:
@@ -364,14 +655,18 @@ class RemoteLogSystem:
     truncate / skip are awaited control RPCs (ref: push :339 + epochEnd
     :107 of TagPartitionedLogSystem, with the RPC hop made explicit)."""
 
-    def __init__(self, transport, log_addr: str, n_logs: int):
+    def __init__(self, transport, log_addrs, n_logs: int):
+        if isinstance(log_addrs, str):  # single-host convenience
+            log_addrs = [log_addrs]
+        assert len(log_addrs) <= n_logs, "more log hosts than logs"
         self.n_logs = n_logs
+        addr_of = lambda i: log_addrs[log_owner(i, len(log_addrs))]
         self._commit = [
-            transport.remote_stream(log_addr, WLTOKEN_LOG_BASE + 2 * i)
+            transport.remote_stream(addr_of(i), WLTOKEN_LOG_BASE + 2 * i)
             for i in range(n_logs)
         ]
         self._ctrl = [
-            transport.remote_stream(log_addr, WLTOKEN_LOG_BASE + 2 * i + 1)
+            transport.remote_stream(addr_of(i), WLTOKEN_LOG_BASE + 2 * i + 1)
             for i in range(n_logs)
         ]
         self._durable_cache = 0
@@ -483,7 +778,7 @@ class TxnHost:
     one process (ref: the cluster-controller/master machine class)."""
 
     def __init__(self, transport, datadir: Optional[str], spec: dict,
-                 log_addr: str, storage_addr: str):
+                 log_addrs, storage_addr: str, resolver_addr=None):
         from .coordination import (
             CoordinatedState,
             CoordinatorRegister,
@@ -498,7 +793,25 @@ class TxnHost:
         kw = _spec_kw(spec)
         self.n_logs = kw["n_logs"]
         self.n_storage = kw["n_storage"]
-        self.log_system = RemoteLogSystem(transport, log_addr, self.n_logs)
+        self.n_resolvers = kw["n_resolvers"]
+        self.resolver_addr = resolver_addr
+        self.resolver_boundaries = [
+            b.encode() if isinstance(b, str) else b
+            for b in spec.get("resolver_boundaries", [])
+        ]
+        # Default partition: evenly split the byte space for any split
+        # points the spec does not name.
+        while len(self.resolver_boundaries) < self.n_resolvers - 1:
+            i = len(self.resolver_boundaries)
+            self.resolver_boundaries.append(
+                bytes([(256 * (i + 1)) // self.n_resolvers])
+            )
+        self.balancer = None
+        self._resolver_ctrl = (
+            transport.remote_stream(resolver_addr, WLTOKEN_RESOLVER_BASE)
+            if resolver_addr is not None else None
+        )
+        self.log_system = RemoteLogSystem(transport, log_addrs, self.n_logs)
         self.storage_ctrl = {
             tag: transport.remote_stream(
                 storage_addr, WLTOKEN_STORAGE_BASE + 2 * tag + 1
@@ -655,8 +968,33 @@ class TxnHost:
             self.ratekeeper.stop()
         self.generation = generation
         self.master = Master(init_version=start_version)
-        self.resolver = ResolverRole(ConflictSetCPU(start_version),
-                                     init_version=start_version)
+        resolvers = resolver_config = None
+        if self.resolver_addr is not None:
+            # Recruit the remote per-generation resolver fleet (an
+            # unreachable resolver host fails THIS attempt; the controller
+            # retries — same contract as the storage rollback confirms).
+            from .resolution import ResolutionBalancer, ResolverConfig
+
+            init = InitResolversRequest(generation, start_version)
+            self._resolver_ctrl.send(init)
+            got = await timeout(
+                init.reply.future, SERVER_KNOBS.ROLE_RPC_TIMEOUT, _LOST
+            )
+            if got is _LOST:
+                raise OperationFailed(
+                    "resolver host did not confirm recruitment"
+                )
+            resolvers = [
+                RemoteResolver(self.transport, self.resolver_addr, i,
+                               generation=generation)
+                for i in range(self.n_resolvers)
+            ]
+            resolver_config = ResolverConfig(self.resolver_boundaries)
+            self.balancer = ResolutionBalancer(resolver_config, resolvers)
+            self.resolver = resolvers[0]
+        else:
+            self.resolver = ResolverRole(ConflictSetCPU(start_version),
+                                         init_version=start_version)
         storage_statuses = [
             _RemoteStorageStatus(tag, ctrl)
             for tag, ctrl in self.storage_ctrl.items()
@@ -667,6 +1005,7 @@ class TxnHost:
             self.master, self.resolver, tlog=None,
             ratekeeper=self.ratekeeper, generation=generation,
             log_system=self.log_system, shard_map=self.shard_map,
+            resolvers=resolvers, resolver_config=resolver_config,
         )
         self.proxy.metadata_hook = self._apply_metadata
         self.ratekeeper.start()
@@ -675,6 +1014,11 @@ class TxnHost:
             self._status_poller(storage_statuses), TaskPriority.DEFAULT,
             name="statusPoller",
         ))
+        if resolvers is not None:
+            self._gen_tasks.add(spawn(
+                self._balancer_loop(resolvers), TaskPriority.DEFAULT,
+                name="resolutionBalancer",
+            ))
         self.grv_ref.target = self.proxy.grv_stream
         self.commit_ref.target = self.proxy.commit_stream
         self.location_ref.target = self.proxy.location_stream
@@ -738,6 +1082,26 @@ class TxnHost:
                 "Version", target
             ).detail("MultiProcess", True).log()
             return
+
+    async def _balancer_loop(self, resolvers) -> None:
+        """Master-side resolutionBalancing over the wire (ref:
+        masterserver.actor.cpp:896): pull each remote resolver's load +
+        key sample, then let the balancer move a hot boundary; proxies
+        route the next windows under the updated shared config."""
+        loop = current_loop()
+        while True:
+            await loop.delay(SERVER_KNOBS.RATEKEEPER_UPDATE_INTERVAL)
+            try:
+                for r in resolvers:
+                    await r.refresh_status()
+                self.balancer.step(self.master.version)
+            except BaseException as e:  # noqa: BLE001 — transient RPC loss
+                from ..core.errors import ActorCancelled
+
+                if isinstance(e, ActorCancelled):
+                    raise
+                TraceEvent("ResolutionBalancerSkipped",
+                           severity=20).error(e).log()
 
     async def _status_poller(self, storage_statuses) -> None:
         loop = current_loop()
@@ -865,31 +1229,62 @@ def run_role_host(role_class: str, cluster_file: str, datadir: str,
     # across process restarts, so peers' cached addresses stay valid (the
     # reference pins fdbd listen addresses in its conf the same way).
     port = spec.get("ports", {}).get(role_class, port)
+    # Per-process trace file (the reference's fdbd writes one per process):
+    # operators and tests read role behavior from the datadir.
+    from ..core.trace import TraceSink, set_global_sink
+
+    os.makedirs(datadir, exist_ok=True)
+    set_global_sink(TraceSink(path=os.path.join(datadir, "trace.jsonl"),
+                              keep_in_memory=False))
     loop, transport = real_loop_with_transport(port=port)
     with _loop_ctx(loop):
 
         def stopping() -> bool:
             return stop_event is not None and stop_event.is_set()
 
+        n_log_hosts = spec.get("n_log_hosts", 1)
+        log_keys = log_host_classes(n_log_hosts)
+
+        async def _all_log_addrs():
+            addrs = []
+            for key in log_keys:
+                a = await _wait_for(cluster_file, key, stopping)
+                if a is None:
+                    return None
+                addrs.append(a)
+            return addrs
+
         async def main():
             host = None
-            if role_class == "log":
+            if role_class in log_keys:
+                idx = log_keys.index(role_class)
                 host = LogHost(transport, f"{datadir}/log",
-                               spec.get("n_logs", 2))
+                               spec.get("n_logs", 2), host_index=idx,
+                               n_log_hosts=n_log_hosts)
             elif role_class == "storage":
-                log_addr = await _wait_for(cluster_file, "log", stopping)
-                if log_addr is None:
+                log_addrs = await _all_log_addrs()
+                if log_addrs is None:
                     return
                 host = StorageHost(transport, f"{datadir}/storage", spec,
-                                   log_addr)
+                                   log_addrs)
+            elif role_class == "resolver":
+                host = ResolverHost(transport, spec)
             elif role_class == "txn":
-                log_addr = await _wait_for(cluster_file, "log", stopping)
+                log_addrs = await _all_log_addrs()
                 storage_addr = await _wait_for(cluster_file, "storage",
                                                stopping)
-                if log_addr is None or storage_addr is None:
+                resolver_addr = None
+                if "resolver" in spec.get("ports", {}):
+                    resolver_addr = await _wait_for(
+                        cluster_file, "resolver", stopping
+                    )
+                    if resolver_addr is None:
+                        return
+                if log_addrs is None or storage_addr is None:
                     return
                 host = TxnHost(transport, f"{datadir}/txn", spec,
-                               log_addr, storage_addr)
+                               log_addrs, storage_addr,
+                               resolver_addr=resolver_addr)
                 # Peers may still be coming up (or restarting): the boot
                 # recovery retries until the log quorum answers — but a
                 # SIGTERM must still win (peers may never come up).
@@ -913,8 +1308,18 @@ def run_role_host(role_class: str, cluster_file: str, datadir: str,
             if ready is not None:
                 ready.address = transport.local_address
                 ready.set()
+            ppid = os.getppid()
             try:
                 while stop_event is None or not stop_event.is_set():
+                    # Orphan watch: role hosts are children of a launcher
+                    # (fdbmonitor / a test harness); if it dies without
+                    # tearing us down (kill -9 on the parent), exit rather
+                    # than leak forever (observed: orphaned fdbd hosts
+                    # from crashed pytest runs alive hours later).
+                    if spec.get("exit_when_orphaned", True) and \
+                            os.getppid() != ppid:
+                        TraceEvent("RoleHostOrphaned", severity=30).log()
+                        break
                     await current_loop().delay(0.05)
             finally:
                 host.stop()

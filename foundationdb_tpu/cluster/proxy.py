@@ -220,6 +220,10 @@ class CommitProxy:
         # postdating the read guarantees no newer generation had committed
         # anything when this version was current (reference order,
         # MasterProxyServer.actor.cpp:875-889).
+        if buggify("proxy_grv_delay"):
+            # GRVs answered late: snapshots age before first use, widening
+            # the conflict window clients actually experience.
+            await current_loop().delay(0.05 * current_loop().random.random01())
         v = self.master.get_live_committed_version()
         try:
             await self._confirm_epoch_live()
@@ -280,6 +284,16 @@ class CommitProxy:
             TraceEvent("ProxyCommitBatchError",
                        severity=30 if (fenced or lost_rpc) else 40
                        ).error(e).log()
+            if fenced:
+                # Some log holds a newer lock (possibly a PARTIAL lock
+                # from a recovery attempt that then lost a log host): this
+                # generation can never commit again. Latch dead so the
+                # health probe reports unhealthy and the controller keeps
+                # recovering — without the latch, the compensation path
+                # masks the fence as commit_unknown_result and a
+                # half-locked cluster wedges forever (found by the
+                # 2-log-host SIGKILL test).
+                self._epoch_dead = True
             try:
                 for role in (self.resolvers or [self.resolver]):
                     await role.skip_window(prev_version, version)
@@ -290,7 +304,7 @@ class CommitProxy:
                 # dead and recovery owns the chains now. Any OTHER failure
                 # propagates loudly (a wedged chain must never be silent —
                 # and the controller's commit-path health probe detects it).
-                pass
+                self._epoch_dead = True
             # Error mapping for clients: an epoch-locked tlog refusal
             # definitively did NOT commit (retryable not_committed, the
             # retry lands on the new generation); a lost role RPC is
@@ -340,9 +354,20 @@ class CommitProxy:
                 ),
                 system_mutations=sys_muts if i == 0 else (),
                 committed_feedback=feedback if i == 0 else (),
+                epoch=self.generation,
             ))
+        async def _one_resolver(role, br):
+            if buggify("proxy_resolver_fanout_skew"):
+                # Fan-out requests reach resolvers in scrambled order; the
+                # per-resolver (prevVersion -> version) chain must still
+                # serialize windows correctly.
+                await current_loop().delay(
+                    0.02 * current_loop().random.random01()
+                )
+            return await role.resolve_batch(br)
+
         tasks = [
-            _spawn(role.resolve_batch(br), TaskPriority.RESOLVER,
+            _spawn(_one_resolver(role, br), TaskPriority.RESOLVER,
                    name=f"resolve{i}")
             for i, (role, br) in enumerate(zip(self.resolvers, batch_reqs))
         ]
@@ -490,6 +515,7 @@ class CommitProxy:
                 version=version,
                 last_receive_version=prev_version,
                 transactions=txns,
+                epoch=self.generation,
             )
             result = await self._call_endpoint(
                 self.resolver_endpoint, resolve_req
@@ -500,6 +526,7 @@ class CommitProxy:
                 version=version,
                 last_receive_version=prev_version,
                 transactions=txns,
+                epoch=self.generation,
             )
             result = await self.resolver.resolve_batch(resolve_req)
 

@@ -90,10 +90,20 @@ class Ratekeeper:
         return max(10.0, frac * 100_000.0)
 
     async def _update_loop(self):
+        from ..core.runtime import buggify
+
         loop = current_loop()
         while True:
             await loop.delay(SERVER_KNOBS.RATEKEEPER_UPDATE_INTERVAL)
+            if buggify("ratekeeper_stale_update"):
+                # A tick's worth of stale inputs (slow status RPCs).
+                await loop.delay(
+                    SERVER_KNOBS.RATEKEEPER_UPDATE_INTERVAL
+                    * loop.random.random01()
+                )
             new_rate = self._compute_rate()
+            if buggify("ratekeeper_budget_collapse", 0.1):
+                new_rate = 1.0  # transient near-zero admission
             if new_rate != self.tps_limit:
                 TraceEvent("RkUpdate").detail("TPSLimit", new_rate).detail(
                     "DurabilityLag",

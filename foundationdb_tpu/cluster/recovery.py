@@ -31,7 +31,7 @@ from __future__ import annotations
 from typing import Callable, Optional
 
 from ..core.actors import ActorCollection
-from ..core.errors import OperationFailed
+from ..core.errors import OperationFailed, TLogStopped
 from ..core.knobs import SERVER_KNOBS
 from ..core.runtime import TaskPriority, current_loop, spawn
 from ..core.trace import TraceEvent
@@ -292,6 +292,16 @@ class RecoverableCluster:
 
         if self.proxy is None:
             return False
+        if getattr(self.proxy, "_epoch_dead", False):
+            # The proxy itself proved it is fenced (a newer lock exists on
+            # some log): unhealthy regardless of what a probe reply says.
+            return False
+        from ..core.runtime import buggify, current_loop
+
+        if buggify("controller_slow_probe"):
+            # Health probes lag: failures detected late, recoveries
+            # bunched; liveness must still converge.
+            await current_loop().delay(0.3 * current_loop().random.random01())
         probe = CommitTransactionRequest(
             read_snapshot=0, read_conflict_ranges=(),
             write_conflict_ranges=(), mutations=(),
@@ -299,9 +309,17 @@ class RecoverableCluster:
         self.commit_ref.send(probe)
         try:
             got = await timeout(probe.reply.future, 0.6, default=None)
+        except TLogStopped:
+            # The probe was refused by an epoch fence: a NEWER lock exists
+            # somewhere (e.g. a previous recovery attempt locked part of
+            # the log quorum before losing a host), so THIS generation can
+            # never commit again — recovery must run, not be skipped.
+            # Found by the 2-log-host SIGKILL test: a partial lock wedged
+            # the cluster forever while the probe kept reporting healthy.
+            return False
         except BaseException:  # noqa: BLE001
-            # An ERRORED reply still proves the pipeline answers; only
-            # silence (a wedged chain) is unhealthy.
+            # Any OTHER errored reply still proves the pipeline answers;
+            # only silence (a wedged chain) is unhealthy.
             return True
         return got is not None
 

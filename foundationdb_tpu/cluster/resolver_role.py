@@ -105,6 +105,12 @@ class ResolverRole:
     async def resolve_batch(
         self, req: ResolveTransactionBatchRequest
     ) -> ConflictBatchResult:
+        from ..core.runtime import buggify, current_loop
+
+        if buggify("resolver_slow_batch"):
+            # A straggling resolver: the proxy's verdict merge must wait
+            # (and successor windows chain behind this one).
+            await current_loop().delay(0.05 * current_loop().random.random01())
         self.apply_feedback(getattr(req, "committed_feedback", ()))
         await self.version.when_at_least(req.prev_version)
         if self.version.get() != req.prev_version:

@@ -161,6 +161,10 @@ class StorageServer:
         loop = current_loop()
         while True:
             await loop.delay(SERVER_KNOBS.STORAGE_COMMIT_INTERVAL)
+            if buggify("storage_flush_stall"):
+                # A long fsync: the tlog keeps the un-popped prefix and
+                # the ratekeeper sees the growing durability lag.
+                await loop.delay(0.2 * loop.random.random01())
             before = self.engine_durable
             horizon = self._flush_once()
             if horizon > before:
@@ -375,12 +379,17 @@ class StorageServer:
                 raise WrongShardServer()
 
     async def get_value(self, req: GetValueRequest) -> Optional[bytes]:
+        if buggify("storage_slow_read"):
+            # A hot replica: hedged reads / load balance must route around.
+            await current_loop().delay(0.05 * current_loop().random.random01())
         await self._wait_for_version(req.version)
         self._check_owned(req.key, key_after(req.key))
         self.metrics.on_read()
         return self.data.get(req.key, req.version)
 
     async def get_range(self, req: GetRangeRequest):
+        if buggify("storage_slow_range"):
+            await current_loop().delay(0.05 * current_loop().random.random01())
         await self._wait_for_version(req.version)
         self._check_owned(req.begin, req.end)
         self.metrics.on_read()

@@ -100,6 +100,10 @@ class MemoryTLog:
         entries are invisible: storage must never apply (and e.g. fire
         watches for) a commit that could still be lost, or a reader could
         observe a commit before its client's commit() resolves."""
+        if buggify("tlog_slow_peek"):
+            # Storage cursors fall behind: un-popped log grows, and the
+            # ratekeeper's queue-bytes input must react.
+            await current_loop().delay(0.1 * current_loop().random.random01())
         while True:
             d = self.durable.get()
             out = [e for e in self._entries if from_version < e[0] <= d]

@@ -63,7 +63,25 @@ def _spec_from_file(path: str) -> dict:
 def run_simulation(path: str) -> int:
     from .workloads.tester import run_spec
 
-    result = run_spec(_spec_from_file(path))
+    spec = _spec_from_file(path)
+    if spec.get("randomized"):
+        # Per-seed randomized SimulationConfig (sim/config.py): each seed
+        # derives cluster shape + knobs + workload mix deterministically;
+        # the printed config IS the reproduction recipe. Always emits the
+        # one-line JSON contract, even on malformed specs.
+        from .sim.config import run_randomized
+
+        try:
+            seeds = spec["seeds"]
+            run_randomized(seeds, log=lambda m: print(m, file=sys.stderr))
+        except BaseException as e:  # noqa: BLE001 - CI parses stdout
+            print(json.dumps(
+                {"ok": False, "error": f"{type(e).__name__}: {e}"}
+            ))
+            return 1
+        print(json.dumps({"ok": True, "seeds": seeds}))
+        return 0
+    result = run_spec(spec)
     print(json.dumps(result, default=str, indent=2))
     return 0 if result.get("ok") and result.get("sev_errors", 0) == 0 else 1
 
@@ -130,9 +148,10 @@ def main(argv=None) -> int:
     ap.add_argument("--sharded", action="store_true",
                     help="fdbd: start the sharded/replicated tier")
     ap.add_argument("-c", "--class", dest="process_class",
-                    choices=["log", "storage", "txn"],
                     help="fdbd: host ONE role class of a multi-process "
-                         "cluster (requires --cluster-file and --datadir)")
+                         "cluster: log / logN (one failure domain of an "
+                         "N-host log quorum) / storage / txn (requires "
+                         "--cluster-file and --datadir)")
     ap.add_argument("-C", "--cluster-file",
                     help="shared cluster file (multi-process discovery)")
     ap.add_argument("-d", "--datadir", help="data directory (durable tier)")

@@ -1,0 +1,144 @@
+"""Per-seed randomized simulation configuration.
+
+The reference derives a SimulationConfig from the test's random seed —
+redundancy mode, storage-engine choice, process/machine counts and a raft
+of knob randomizations (fdbserver/SimulatedCluster.actor.cpp:696 setupAndRun
+-> SimulationConfig; flow/Knobs randomize under BUGGIFY) — so every seed
+exercises a different cluster shape with the same workload semantics.
+
+generate_config(seed) is the equivalent: a deterministic function from
+seed to a tester spec (workloads/tester.run_spec input), covering
+
+  - cluster kind + role counts (storage 3-6, logs 1-3),
+  - replication mode, constrained by the fleet size,
+  - a randomized subset of knob overrides (batch sizing, shard
+    thresholds, lease/heartbeat timing — knobs the repo actually uses),
+  - a workload mix: one correctness core (Cycle) plus fault/adversary
+    workloads drawn per seed, under BUGGIFY.
+
+Every generated spec is a plain printable dict: CI prints it per run, so
+any failure reproduces from the seed alone (run_spec is deterministic).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any
+
+# (knob name, which registry, (lo, hi)) — randomization ranges for knobs
+# governing behavior the repo actually has. Ints randomize inclusive.
+_KNOB_RANGES = [
+    ("COMMIT_TRANSACTION_BATCH_COUNT_MAX", "server", (2, 64)),
+    ("COMMIT_TRANSACTION_BATCH_INTERVAL_MIN", "server", (0.0005, 0.02)),
+    ("GRV_BATCH_INTERVAL", "client", (0.0005, 0.02)),
+    ("MAX_BATCH_SIZE", "client", (4, 64)),
+    ("MIN_SHARD_BYTES", "server", (64, 4096)),
+    ("RATEKEEPER_UPDATE_INTERVAL", "server", (0.05, 0.5)),
+    ("DEFAULT_BACKOFF", "client", (0.005, 0.1)),
+    ("TPU_STICKY_DECAY_BATCHES", "server", (4, 128)),
+]
+
+_REPLICATION_FOR = {3: ["single", "double", "triple"],
+                    2: ["single", "double"], 1: ["single"]}
+
+
+def generate_config(seed: int) -> dict[str, Any]:
+    rng = random.Random(seed)
+    n_storage = rng.randint(3, 6)
+    n_logs = rng.randint(1, 3)
+    replication = rng.choice(_REPLICATION_FOR[min(n_storage, 3)])
+
+    knobs: dict[str, Any] = {}
+    for name, reg, (lo, hi) in _KNOB_RANGES:
+        if rng.random() < 0.5:
+            continue  # leave at default (the reference randomizes subsets)
+        if isinstance(lo, int):
+            knobs[f"{reg}:{name}"] = rng.randint(lo, hi)
+        else:
+            knobs[f"{reg}:{name}"] = round(lo + rng.random() * (hi - lo), 5)
+
+    workloads: list[dict[str, Any]] = [
+        {"name": "Cycle", "nodes": rng.randint(8, 24),
+         "clients": rng.randint(2, 5), "txns": rng.randint(10, 30)},
+    ]
+    optional = [
+        {"name": "Serializability", "clients": 3,
+         "txns": rng.randint(8, 20)},
+        {"name": "Watches", "pairs": rng.randint(4, 10), "rounds": 2},
+        {"name": "ConflictRange", "key_space": rng.randint(32, 160)},
+        {"name": "WriteDuringRead", "key_space": rng.randint(20, 80),
+         "txns": rng.randint(15, 40)},
+        {"name": "FuzzApi", "rounds": 2},
+        {"name": "VersionStamp", "clients": rng.randint(2, 4),
+         "txns": rng.randint(5, 12)},
+        {"name": "BackupRestore", "snapshots": 2},
+    ]
+    rng.shuffle(optional)
+    workloads.extend(optional[: rng.randint(1, 3)])
+    # Movement + distribution faults only where shards exist.
+    movers = rng.random() < 0.7
+    attrition = rng.random() < 0.7
+    if movers:
+        workloads.append({
+            "name": "RandomMoveKeys",
+            "interval": round(0.2 + rng.random(), 2),
+            # Under attrition every move can lose its race with a
+            # recovery; progress becomes best-effort, correctness is
+            # carried by the concurrent workloads + ConsistencyCheck.
+            "require_progress": not attrition,
+        })
+        workloads.append({"name": "DataDistribution"})
+    if attrition:
+        workloads.append({"name": "Attrition",
+                          "interval": round(0.5 + rng.random(), 2),
+                          "kills": rng.randint(1, 3)})
+    if rng.random() < 0.5 and replication != "single":
+        workloads.append({"name": "RebootStorage",
+                          "reboots": rng.randint(1, 3),
+                          "interval": round(0.4 + rng.random(), 2)})
+
+    return {
+        "seed": seed,
+        "buggify": True,
+        "knobs": knobs,
+        "cluster": {
+            "kind": "recoverable_sharded",
+            "n_storage": n_storage,
+            "n_logs": n_logs,
+            "replication": replication,
+        },
+        "workloads": workloads,
+    }
+
+
+def run_randomized(seeds, log=print) -> list[dict[str, Any]]:
+    """Run generate_config(seed) for every seed; print each config (the
+    reproduction recipe) and collect results. Raises on the first failed
+    seed AFTER running all of them, so CI reports every bad seed."""
+    import json
+
+    from ..workloads.tester import run_spec
+
+    results = []
+    failures = []
+    for seed in seeds:
+        spec = generate_config(seed)
+        log(f"[sim seed {seed}] config: {json.dumps(spec, sort_keys=True)}")
+        try:
+            res = run_spec(spec)
+        except BaseException as e:  # noqa: BLE001 - one bad seed must not
+            # silence the report for the others
+            res = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+        ok = res.get("ok") and not res.get("sev_errors")
+        log(f"[sim seed {seed}] ok={res.get('ok')} "
+            f"sev_errors={res.get('sev_errors')} "
+            + (f"error={res.get('error')}" if res.get("error") else ""))
+        results.append(res)
+        if not ok:
+            failures.append(seed)
+    if failures:
+        raise AssertionError(
+            f"randomized simulation failed for seeds {failures} "
+            "(re-run generate_config(seed) to reproduce)"
+        )
+    return results

@@ -70,7 +70,11 @@ class RandomMoveKeysWorkload:
                     err
                 ).log()
 
+    require_progress = True  # spec-settable: under heavy attrition, every
+    # attempted move can legitimately lose its race with a recovery.
+
     async def check(self) -> bool:
         """The workload itself has no invariant (the concurrent
-        correctness workloads carry them); success = it actually moved."""
-        return self.moves_done > 0
+        correctness workloads carry them); success = it actually moved
+        (unless the spec marked progress best-effort)."""
+        return self.moves_done > 0 or not self.require_progress

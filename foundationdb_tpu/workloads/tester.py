@@ -27,7 +27,7 @@ from typing import Any
 from ..core import loop_context, sim_loop
 from ..core.actors import all_of
 from ..core.runtime import spawn
-from ..core.trace import global_sink
+from ..core.trace import TraceEvent, global_sink
 
 
 class SpecError(ValueError):
@@ -157,7 +157,9 @@ async def _run_workloads(cluster, db, spec) -> dict[str, Any]:
                 raise SpecError("RandomMoveKeys needs a sharded cluster")
             wl = RandomMoveKeysWorkload(
                 cluster, interval=w.get("interval", 0.3)
-            ).start()
+            )
+            wl.require_progress = w.get("require_progress", True)
+            wl.start()
             stoppers.append((wl.stop, wl.wait_stopped))
             checkers.append((rkey, wl.check,
                              lambda wl=wl: {"moves": wl.moves_done}))
@@ -227,6 +229,74 @@ async def _run_workloads(cluster, db, spec) -> dict[str, Any]:
                 duration=w.get("duration", 3.0),
             )).done))
             checkers.append((rkey, None, wl.metrics))
+        elif name == "VersionStamp":
+            from .more import VersionStampWorkload
+
+            wl = VersionStampWorkload(db)
+            starters.append((rkey, spawn(wl.run(
+                clients=w.get("clients", 3), txns=w.get("txns", 8),
+            )).done))
+            checkers.append((rkey, wl.check,
+                             lambda wl=wl: {"acked": wl.acked,
+                                            "failures": wl.failures[:3]}))
+        elif name == "Rollback":
+            from .more import RollbackWorkload
+
+            if not hasattr(cluster, "kill_transaction_system"):
+                raise SpecError("Rollback needs a recoverable cluster")
+            wl = RollbackWorkload(db, cluster)
+            starters.append((rkey, spawn(wl.run(
+                writes=w.get("writes", 12),
+                kill_every=w.get("kill_every", 4),
+            )).done))
+            checkers.append((rkey, wl.check,
+                             lambda wl=wl: {"acked": len(wl.acked),
+                                            "failures": wl.failures[:3]}))
+        elif name == "BackupRestore":
+            from .more import BackupRestoreWorkload
+
+            wl = BackupRestoreWorkload(db)
+            starters.append((rkey, spawn(wl.run(
+                snapshots=w.get("snapshots", 2),
+            )).done))
+            checkers.append((rkey, wl.check,
+                             lambda wl=wl: {"snapshots": len(wl.images),
+                                            "failures": wl.failures[:3]}))
+        elif name == "RebootStorage":
+            # Machine-level reboot (ref: sim2's machine reboot,
+            # fdbrpc/sim2.actor.cpp:1217 — stop a process WITHOUT state
+            # loss, then bring it back): a random storage replica stops
+            # serving, reads hedge to its teammates, and on restart it
+            # catches up from its log cursor. Requires replication >
+            # single or reads would stall.
+            if not hasattr(cluster, "storages"):
+                raise SpecError("RebootStorage needs a sharded cluster")
+
+            async def reboot_loop(n=w.get("reboots", 2),
+                                  interval=w.get("interval", 0.6)):
+                from ..core import delay
+                from ..core.runtime import current_loop
+
+                loop = current_loop()
+                done = 0
+                for _ in range(n):
+                    await delay(interval * (0.5 + loop.random.random01()))
+                    s = cluster.storages[
+                        loop.random.random_int(0, len(cluster.storages))
+                    ]
+                    TraceEvent("SimRebootStorage").detail(
+                        "Tag", getattr(s, "tag", -1)
+                    ).log()
+                    s.stop()
+                    await delay(0.2 + 0.3 * loop.random.random01())
+                    s.start()
+                    done += 1
+                return done
+
+            starters.append((rkey, spawn(reboot_loop()).done))
+            checkers.append((rkey, None, lambda w=w: {
+                "reboots": w.get("reboots", 2)
+            }))
         elif name == "DataDistribution":
             dd = cluster.start_data_distribution(
                 interval=w.get("interval", 0.2)
@@ -270,6 +340,34 @@ async def _run_workloads(cluster, db, spec) -> dict[str, Any]:
     return results
 
 
+def _apply_knobs(overrides: dict):
+    """Apply spec knob overrides ("server:NAME" / "client:NAME" -> value);
+    returns an undo callable (specs must not leak knobs into later runs —
+    the reference's simulated knob randomization is per-process)."""
+    from ..core.knobs import CLIENT_KNOBS, SERVER_KNOBS
+
+    regs = {"server": SERVER_KNOBS, "client": CLIENT_KNOBS}
+    saved = []
+
+    def undo():
+        for reg, name, old in saved:
+            setattr(reg, name, old)
+
+    try:
+        for key, value in (overrides or {}).items():
+            reg_name, _, name = key.partition(":")
+            if reg_name not in regs:
+                raise SpecError(f"knob key {key!r}: registry must be "
+                                "'server' or 'client'")
+            reg = regs[reg_name]
+            saved.append((reg, name, getattr(reg, name)))
+            reg.set_knob(name, str(value))
+    except BaseException:
+        undo()  # a partial apply must not leak into later runs
+        raise
+    return undo
+
+
 def run_spec(spec: dict) -> dict[str, Any]:
     """Run one spec in a fresh deterministic loop; returns results incl.
     per-workload metrics, overall ok, and the SevError count."""
@@ -277,6 +375,7 @@ def run_spec(spec: dict) -> dict[str, Any]:
 
     # Fresh sink per spec: sev_errors must count THIS run only.
     set_global_sink(TraceSink())
+    undo_knobs = _apply_knobs(spec.get("knobs"))
     loop = sim_loop(seed=spec.get("seed", 1),
                     buggify=spec.get("buggify", False))
     with loop_context(loop):
@@ -284,6 +383,13 @@ def run_spec(spec: dict) -> dict[str, Any]:
             ckind = spec.get("cluster", {}).get("kind", "local")
             ckw = {k: v for k, v in spec.get("cluster", {}).items()
                    if k != "kind"}
+            if "shard_boundaries" in ckw:
+                # JSON specs carry boundaries as strings (same contract as
+                # the multiprocess cluster file, _spec_kw).
+                ckw["shard_boundaries"] = [
+                    b.encode() if isinstance(b, str) else b
+                    for b in ckw["shard_boundaries"]
+                ]
             if ckind == "sharded":
                 from ..cluster.sharded_cluster import ShardedKVCluster
 
@@ -304,6 +410,9 @@ def run_spec(spec: dict) -> dict[str, Any]:
             finally:
                 cluster.stop()
 
-        results = loop.run(main(), timeout_sim_seconds=3600)
+        try:
+            results = loop.run(main(), timeout_sim_seconds=3600)
+        finally:
+            undo_knobs()
     results["sev_errors"] = len(global_sink().has_severity(40))
     return results

@@ -4,6 +4,9 @@ interface, fed by the proxy's commit batcher — differentially checked by
 the Cycle invariant (and implicitly against the CPU path, which the rest of
 the suite runs with the same seeds)."""
 
+import pytest
+
+pytestmark = pytest.mark.slow  # multi-minute tier (see pytest.ini)
 from foundationdb_tpu.cluster import LocalCluster
 from foundationdb_tpu.core.runtime import loop_context, sim_loop
 from foundationdb_tpu.resolver.tpu import ConflictSetTPU

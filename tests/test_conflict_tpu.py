@@ -9,6 +9,8 @@ import random
 
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-minute tier (see pytest.ini)
+
 from foundationdb_tpu.kv.keys import KeyRange, key_after
 from foundationdb_tpu.resolver import (
     COMMITTED,

@@ -8,6 +8,8 @@ un-acked commit is never half-applied after recovery."""
 
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-minute tier (see pytest.ini)
+
 from foundationdb_tpu.core import delay, loop_context
 from foundationdb_tpu.core.runtime import sim_loop
 

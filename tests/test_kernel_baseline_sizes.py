@@ -1,0 +1,153 @@
+"""Kernel correctness at BASELINE.json sizes (slow tier).
+
+BASELINE's eval configs, asserted BIT-FOR-BIT — statuses and, where the
+config is single-resolver, the full step function:
+
+  1. single-resolver, 10K txns, uniform 8-byte keys, 5 reads + 2 writes;
+  2. Zipf-0.99 hot keys, 100K-txn batch;
+  4. 4-resolver key-space partition with cross-shard range stitching;
+  5. sliding 5s-scaled MVCC window, continuous 64K microbatches, GC +
+     insert steady state.
+
+The reference-semantics chain is layered: the native C++ detector is
+pinned bit-for-bit to the Python oracle at small sizes
+(test_native_conflict_set.py), and stands in for it here where the pure-
+Python oracle would take tens of minutes (it is O(history) per splice).
+Config 3 (YCSB-E 1M txns / 64 read ranges) is exercised perf-wise by
+bench.py; its semantics (wide ranges) are covered by the wide-range
+differentials at smaller sizes.
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # multi-minute tier (see pytest.ini)
+
+from foundationdb_tpu.kv.keys import KeyRange
+from foundationdb_tpu.resolver.native_cpu import ConflictSetNativeCPU, load
+from foundationdb_tpu.resolver.tpu import ConflictSetTPU
+from foundationdb_tpu.resolver.types import TxnConflictInfo
+
+if load() is None:  # pragma: no cover
+    pytest.skip("native conflict set not built", allow_module_level=True)
+
+
+def k8(x: int) -> bytes:
+    return struct.pack(">Q", int(x))
+
+
+def gen(rng, n, version, keys, n_reads=5, n_writes=2, lag=100_000):
+    snaps = version - rng.integers(0, lag, size=n)
+    rk = keys(rng, n * n_reads).reshape(n, n_reads)
+    wk = keys(rng, n * n_writes).reshape(n, n_writes)
+    out = []
+    for i in range(n):
+        out.append(TxnConflictInfo(
+            int(snaps[i]),
+            [KeyRange(k8(k), k8(k) + b"\x00") for k in rk[i]],
+            [KeyRange(k8(k), k8(k) + b"\x00") for k in wk[i]],
+        ))
+    return out
+
+
+def uniform(space):
+    return lambda rng, n: rng.integers(0, space, size=n)
+
+
+def zipf099(space):
+    ranks = np.arange(1, space + 1, dtype=np.float64)
+    cdf = np.cumsum(ranks ** -0.99)
+    cdf /= cdf[-1]
+    mul = np.uint64(11400714819323198485)
+
+    def sample(rng, n):
+        r = np.searchsorted(cdf, rng.random(n)).astype(np.uint64)
+        return (r * mul) % np.uint64(space)
+
+    return sample
+
+
+def _diff_run(sampler, batch, n_batches, window=None, seed=1):
+    rng = np.random.default_rng(seed)
+    tpu = ConflictSetTPU(max_key_bytes=9, initial_capacity=1 << 16)
+    ora = ConflictSetNativeCPU()
+    v = 1_000_000
+    for b in range(n_batches):
+        txns = gen(rng, batch, v, sampler)
+        no = max(0, v - window) if window else 0
+        want = ora.resolve(v, no, txns)
+        got = tpu.resolve(v, no, txns)
+        assert got.statuses == want.statuses, f"batch {b}"
+        v += batch
+    assert tpu.entries() == ora.entries()
+
+
+def test_config1_uniform_10k():
+    _diff_run(uniform(1 << 20), 10_000, 3)
+
+
+def test_config2_zipf_100k():
+    _diff_run(zipf099(1 << 20), 100_000, 2, seed=2)
+
+
+def test_config5_sliding_window_64k():
+    # GC horizon chases the front: steady-state insert + collapse, the
+    # bench's headline config, bit-for-bit incl. the final step function.
+    _diff_run(uniform(1 << 20), 65_536, 4, window=2 * 65_536, seed=3)
+
+
+def test_config4_four_shard_partition():
+    """4-resolver key-space partition with cross-shard range stitching:
+    the mesh-sharded kernel vs a native-backed sharded oracle built from
+    the same clipping (resolver/sharded.py shard_key_ranges)."""
+    import jax
+    from jax.sharding import Mesh
+
+    from foundationdb_tpu.resolver.sharded import (
+        ShardedConflictSetTPU,
+        clip_txns_to_shard,
+        shard_key_ranges,
+    )
+
+    space = 1 << 20
+    bounds = [k8(space // 4), k8(space // 2), k8(3 * space // 4)]
+
+    class ShardedNative:
+        def __init__(self):
+            self.shards = [ConflictSetNativeCPU() for _ in range(4)]
+
+        def resolve(self, version, no, txns):
+            st = np.zeros(len(txns), dtype=np.int64)
+            for cs, (lo, hi) in zip(self.shards, shard_key_ranges(bounds)):
+                local = clip_txns_to_shard(txns, lo, hi)
+                st = np.maximum(
+                    st, np.asarray(cs.resolve(version, no, local).statuses)
+                )
+            return [int(s) for s in st]
+
+    devs = jax.devices("cpu")
+    if len(devs) < 4:  # pragma: no cover
+        pytest.skip("needs 4 virtual devices")
+    with jax.default_device(devs[0]):
+        mesh = Mesh(np.array(devs[:4]), ("resolvers",))
+        tpu = ShardedConflictSetTPU(bounds, mesh, max_key_bytes=9,
+                                    initial_capacity=1 << 14)
+        ora = ShardedNative()
+        rng = np.random.default_rng(4)
+        v = 1_000_000
+        for b in range(3):
+            # Wide cross-shard ranges force the stitching path.
+            txns = gen(rng, 8192, v, uniform(space))
+            for t in txns[::7]:
+                lo = int(rng.integers(0, space - 1))
+                hi = int(rng.integers(lo + 1, space))
+                t.read_ranges = list(t.read_ranges) + [
+                    KeyRange(k8(lo), k8(hi))
+                ]
+            no = max(0, v - 3 * 8192)
+            want = ora.resolve(v, no, txns)
+            got = tpu.resolve(v, no, txns).statuses
+            assert got == want, f"batch {b}"
+            v += 8192

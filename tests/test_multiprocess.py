@@ -12,6 +12,8 @@ import time
 
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-minute tier (see pytest.ini)
+
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 SPEC = {
@@ -37,19 +39,22 @@ def _free_ports(n):
             s.close()
 
 
-def _launch(tmp_path, classes=("log", "storage", "txn")):
+def _launch(tmp_path, classes=("log", "storage", "txn"), spec_extra=None):
     cf = str(tmp_path / "cluster.json")
     from foundationdb_tpu.cluster.multiprocess import write_cluster_file
 
     ports = _free_ports(len(classes))
-    spec = dict(SPEC, ports=dict(zip(classes, ports)))
+    spec = dict(SPEC, **(spec_extra or {}), ports=dict(zip(classes, ports)))
     write_cluster_file(cf, {"spec": spec})
     procs = []
     for cls in classes:
+        # Own process group per host: teardown kills the whole group, so
+        # a crashed/hung run cannot leak fdbd role processes.
         p = subprocess.Popen(
             [sys.executable, "-m", "foundationdb_tpu.server", "-r", "fdbd",
              "-c", cls, "-C", cf, "-d", str(tmp_path / "data" / cls)],
             cwd=ROOT, stderr=subprocess.PIPE, text=True,
+            start_new_session=True,
         )
         procs.append(p)
     # Wait until every class has merged its address.
@@ -71,13 +76,21 @@ def _launch(tmp_path, classes=("log", "storage", "txn")):
 
 
 def _teardown(procs):
+    import signal
+
+    def _group(p, sig):
+        try:
+            os.killpg(os.getpgid(p.pid), sig)
+        except (ProcessLookupError, PermissionError):
+            pass
+
     for p in procs:
-        p.terminate()
+        _group(p, signal.SIGTERM)
     for p in procs:
         try:
             p.wait(timeout=20)
         except subprocess.TimeoutExpired:
-            p.kill()
+            _group(p, signal.SIGKILL)
             p.wait(timeout=10)
 
 
@@ -220,10 +233,12 @@ def test_durability_across_process_kill(cluster3, tmp_path):
             [sys.executable, "-m", "foundationdb_tpu.server", "-r", "fdbd",
              "-c", cls, "-C", cf, "-d", str(tmp_path / "data" / cls)],
             cwd=ROOT, stderr=subprocess.PIPE, text=True,
+            start_new_session=True,  # teardown kills by group: never ours
         )
         relaunched.append(p)
     procs[0], procs[2] = relaunched[0], relaunched[1]
-    time.sleep(2.0)  # recovery runs on txn boot
+    # No fixed sleep: the client's GRV/read retry machinery IS the
+    # readiness probe — the verify body spins until boot recovery serves.
 
     async def verify(db):
         for i in range(15):
@@ -232,4 +247,112 @@ def test_durability_across_process_kill(cluster3, tmp_path):
         assert await db.get(b"after") == b"relaunch"
         return True
 
-    assert _client_run(cf, verify)
+    assert _client_run(cf, verify, timeout_s=180)
+
+
+def test_resolver_host_and_balancer_over_the_wire(tmp_path):
+    """Six processes: 2 log hosts + storage + a RESOLVER host (2 resolvers
+    partitioned over the keyspace) + txn. The proxy's phase-2 fan-out, the
+    verdict merge, the balancer's load/sample pulls and the hot-boundary
+    move all ride the real transport (VERDICT r4 #5). A skewed workload
+    (every key below the b'\\x80' boundary) must trigger a boundary move,
+    and correctness must hold throughout."""
+    classes = ("log0", "log1", "storage", "resolver", "txn")
+    cf, procs = _launch(
+        tmp_path, classes,
+        spec_extra={"n_log_hosts": 2, "n_logs": 2, "n_resolvers": 2},
+    )
+    try:
+        async def body(db):
+            from foundationdb_tpu.workloads.cycle import CycleWorkload
+
+            # Conflict semantics across the remote fan-out: a stale-
+            # snapshot rewrite must abort.
+            await db.set(b"hot", b"0")
+            tr1 = db.create_transaction()
+            tr2 = db.create_transaction()
+            assert await tr1.get(b"hot") == b"0"
+            assert await tr2.get(b"hot") == b"0"
+            tr1.set(b"hot", b"1")
+            await tr1.commit()
+            tr2.set(b"hot", b"2")
+            from foundationdb_tpu.core.errors import NotCommitted
+
+            try:
+                await tr2.commit()
+                raise AssertionError("stale commit must conflict")
+            except NotCommitted:
+                pass
+            # Skewed load: everything lands on resolver 0's range.
+            w = CycleWorkload(db, nodes=10)
+            await w.setup()
+            await w.start(clients=3, txns_per_client=20)
+            assert await w.check(), "cycle invariant over remote resolvers"
+            # Let a couple of balancer ticks run.
+            import asyncio  # noqa: F401 - real-clock loop: plain delay
+
+            from foundationdb_tpu.core.runtime import current_loop
+
+            await current_loop().delay(2.5)
+            w2 = CycleWorkload(db, nodes=10)
+            await w2.setup()
+            await w2.start(clients=2, txns_per_client=10)
+            assert await w2.check()
+            return True
+
+        assert _client_run(cf, body, timeout_s=240)
+    finally:
+        _teardown(procs)
+    trace = (tmp_path / "data" / "txn" / "trace.jsonl").read_text()
+    assert "ResolverHostRecruited" in (
+        (tmp_path / "data" / "resolver" / "trace.jsonl").read_text()
+    )
+    assert "ResolutionBoundaryMoved" in trace, (
+        "hot boundary never moved over the wire"
+    )
+
+
+def test_two_log_hosts_survive_one_host_sigkill(tmp_path):
+    """Cross-host log replication (VERDICT r4 #4): the tlog quorum spans
+    TWO log-host processes (one failure domain each). SIGKILL one host
+    mid-run: commits stall (durability = the full quorum), the relaunched
+    host recovers its logs from the preserved disk, the controller
+    re-recovers, acked writes survive, and the Cycle invariant holds over
+    the healed cluster."""
+    import signal
+
+    classes = ("log0", "log1", "storage", "txn")
+    cf, procs = _launch(tmp_path, classes,
+                        spec_extra={"n_log_hosts": 2, "n_logs": 2})
+    try:
+        async def write(db):
+            for i in range(15):
+                await db.set(b"h%02d" % i, b"v%d" % i)
+            return True
+
+        assert _client_run(cf, write)
+
+        procs[1].send_signal(signal.SIGKILL)
+        procs[1].wait(timeout=20)
+        p = subprocess.Popen(
+            [sys.executable, "-m", "foundationdb_tpu.server", "-r", "fdbd",
+             "-c", "log1", "-C", cf, "-d", str(tmp_path / "data" / "log1")],
+            cwd=ROOT, stderr=subprocess.PIPE, text=True,
+            start_new_session=True,  # teardown kills by group: never ours
+        )
+        procs[1] = p
+
+        async def verify(db):
+            for i in range(15):
+                assert await db.get(b"h%02d" % i) == b"v%d" % i, i
+            from foundationdb_tpu.workloads.cycle import CycleWorkload
+
+            w = CycleWorkload(db, nodes=8)
+            await w.setup()
+            await w.start(clients=2, txns_per_client=8)
+            assert await w.check(), "cycle invariant after log-host loss"
+            return True
+
+        assert _client_run(cf, verify, timeout_s=180)
+    finally:
+        _teardown(procs)

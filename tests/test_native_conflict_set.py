@@ -11,6 +11,8 @@ import struct
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-minute tier (see pytest.ini)
+
 from foundationdb_tpu.kv.keys import KeyRange
 from foundationdb_tpu.resolver.cpu import ConflictSetCPU
 from foundationdb_tpu.resolver.types import TxnConflictInfo

@@ -132,3 +132,27 @@ def test_compound_spec_with_new_workloads_under_faults():
     result = run_spec(spec)
     assert result["ok"], json.dumps(result, default=str, indent=2)[:2000]
     assert result["sev_errors"] == 0
+
+
+def test_versionstamp_rollback_backup_workloads():
+    """The round-5 additions, run as a compound spec under faults on the
+    recoverable sharded tier (VersionStamp's post-commit get_versionstamp
+    is the probe that caught the never-resolving-promise bug)."""
+    from foundationdb_tpu.workloads.tester import run_spec
+
+    result = run_spec({
+        "seed": 77,
+        "buggify": True,
+        "cluster": {"kind": "recoverable_sharded", "n_storage": 4,
+                    "n_logs": 2, "replication": "double"},
+        "workloads": [
+            {"name": "VersionStamp", "clients": 3, "txns": 6},
+            {"name": "BackupRestore", "snapshots": 2},
+            {"name": "Rollback", "writes": 10, "kill_every": 4},
+            {"name": "Cycle", "nodes": 10, "clients": 2, "txns": 10},
+        ],
+    })
+    import json as _json
+
+    assert result["ok"], _json.dumps(result, default=str)[:1500]
+    assert result["sev_errors"] == 0

@@ -13,6 +13,8 @@ import struct
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-minute tier (see pytest.ini)
+
 from foundationdb_tpu.kv.keys import KeyRange
 from foundationdb_tpu.resolver.sharded import (
     ShardedConflictSetCPU,
