@@ -132,7 +132,7 @@ async def restore(
     path: str,
     begin: bytes = b"",
     end: bytes = b"\xff",
-    chunk_rows: int = 500,
+    chunk_rows: int | None = None,
 ) -> int:
     """Replace [begin, end) with the backup's contents; returns the row
     count (ref: restore applies range files then replays logs — only the
@@ -144,6 +144,10 @@ async def restore(
     (RESTORE_MARKER in the `\\xff` system space): a crashed restore is
     detectable by the marker and must be re-run to completion, and writers
     of the range should be quiesced while it is set."""
+    if chunk_rows is None:
+        from .core.knobs import CLIENT_KNOBS
+
+        chunk_rows = CLIENT_KNOBS.RESTORE_WRITE_BATCH_ROWS
     total = 0
     marker = RESTORE_MARKER
 

@@ -2,8 +2,10 @@
 
     python -m foundationdb_tpu.cli
 
-Runs a single-process cluster on a real-time event loop and evaluates one
-command per line. Keys/values accept Python bytes-literal escapes
+Runs an in-process SHARDED cluster (4 storage servers, double
+replication, data distribution running) on a real-time event loop and
+evaluates one command per line — so the management verbs operate on a
+real fleet. Keys/values accept Python bytes-literal escapes
 (e.g. prefix\\x00suffix).
 
 Commands (the fdbcli core surface):
@@ -13,6 +15,13 @@ Commands (the fdbcli core surface):
     clearrange <begin> <end>      clear a range
     getrange <begin> <end> [lim]  list key/value pairs
     status [json]                 cluster status (summary or full JSON)
+    configure <k=v> ...           set replicated configuration (\xff/conf)
+    configuration                 show replicated configuration
+    exclude [tag ...]             exclude storage servers (no args: list);
+                                  data distribution drains them
+    include <tag ...|all>         re-include excluded servers
+    coordinators                  list the coordination quorum
+    throttle <tps|off>            manual ratekeeper cap (fdbcli throttle)
     backup <url>                  snapshot into a container (fdbbackup)
     restore <url> [version]       restore a container snapshot (fdbrestore)
     backups <url>                 list a container's snapshot versions
@@ -46,11 +55,23 @@ def _p(raw: bytes) -> str:
 
 
 class Cli:
-    def __init__(self):
+    def __init__(self, sharded: bool = True):
         self.loop = EventLoop()  # real clock: an interactive tool
         self._ctx = loop_context(self.loop)
         self._ctx.__enter__()
-        self.cluster = LocalCluster().start()
+        if sharded:
+            # The management verbs (exclude/include + DD draining) need a
+            # storage fleet; this is the fdbcli-against-a-real-cluster
+            # shape.
+            from .cluster.sharded_cluster import ShardedKVCluster
+
+            self.cluster = ShardedKVCluster(
+                n_storage=4, replication="double"
+            ).start()
+            self.dd = self.cluster.start_data_distribution(interval=0.2)
+        else:
+            self.cluster = LocalCluster().start()
+            self.dd = None
         self.db: Database = self.cluster.database()
         self.write_mode = False
 
@@ -124,6 +145,58 @@ class Cli:
                 f"Roles:          "
                 + ", ".join(r["role"] for r in c["roles"])
             )
+        if cmd == "configure":
+            self._need_write_mode()
+            from .cluster import management
+
+            settings = dict(a.split("=", 1) for a in args)
+            self._run(management.configure(self.db, **settings))
+            return "Configuration changed"
+        if cmd == "configuration":
+            from .cluster import management
+
+            conf = self._run(management.get_configuration(self.db))
+            return "\n".join(f"{k} = {v}" for k, v in sorted(conf.items())) \
+                or "(defaults)"
+        if cmd == "exclude":
+            from .cluster import management
+
+            if not args:
+                ex = self._run(management.get_excluded_servers(self.db))
+                return ("Excluded servers: "
+                        + (", ".join(map(str, sorted(ex))) or "(none)"))
+            self._need_write_mode()
+            tags = [int(a) for a in args]
+            self._run(management.exclude_servers(self.db, tags))
+            return (f"Excluded {', '.join(map(str, tags))}; data "
+                    "distribution will drain them (watch `status json`)")
+        if cmd == "include":
+            self._need_write_mode()
+            from .cluster import management
+
+            tags = None if args == ["all"] or not args else [
+                int(a) for a in args
+            ]
+            self._run(management.include_servers(self.db, tags))
+            return "Included"
+        if cmd == "coordinators":
+            coords = getattr(self.cluster, "coordinators", None)
+            if not coords:
+                return ("This deployment runs without a coordination "
+                        "quorum (single-process cluster)")
+            return "\n".join(
+                f"{c.name}: {'available' if c.available else 'DOWN'}"
+                for c in coords
+            )
+        if cmd == "throttle":
+            rk = getattr(self.cluster, "ratekeeper", None)
+            if rk is None:
+                return "No ratekeeper in this deployment"
+            if not args or args[0] == "off":
+                rk.manual_limit = None
+                return "Throttle cleared (automatic rate control)"
+            rk.manual_limit = float(args[0])
+            return f"Manual throttle: {rk.manual_limit} TPS cap"
         if cmd == "backup":
             if len(args) != 1:
                 return "usage: backup <container-url>  (file://dir | memory://name)"
@@ -159,7 +232,7 @@ class Cli:
 
 def main() -> None:
     cli = Cli()
-    print("fdbtpu-cli: single-process cluster started (type help)")
+    print("fdbtpu-cli: sharded cluster started: 4 storage / double replication (type help)")
     try:
         while True:
             try:

@@ -95,7 +95,28 @@ class DurableTaggedTLog(TaggedTLog):
         # never persist versions a mid-recovery truncation is about to
         # discard (they are un-unwritable there).
         self.entry_durable = init_version
+        # Spill tier (ref: TLogServer.actor.cpp:518 updatePersistentData /
+        # :613 updateStorage): in-memory unpopped data is BOUNDED by
+        # SERVER_KNOBS.TLOG_SPILL_THRESHOLD; the overflow moves to an
+        # IKeyValueStore and peeks merge it back. The spill store is a
+        # disk-backed cache of already-fsynced DiskQueue records — losing
+        # it costs a replay, never durability.
+        self._path_prefix = path_prefix
+        self._spill = None          # lazy engine
+        self._spill_hi = None       # highest spilled version (None = none)
+        self._entry_bytes: dict[int, int] = {}
+        self._mem_bytes = 0
+        # Spilled backlog accounting: the un-popped queue does not vanish
+        # from metrics just because it moved to disk (status/queue_bytes
+        # add these to the in-memory numbers).
+        self._spill_bytes_by_v: dict[int, int] = {}
+        self.spilled_bytes = 0
         self._recover_from_queue(init_version)
+        self._maybe_spill()  # bound memory after a large replay too
+
+    @property
+    def spilled_entries(self) -> int:
+        return len(self._spill_bytes_by_v)
 
     # -- record IO --
     def _push_blob(self, kind: int, payload: bytes) -> int:
@@ -126,6 +147,7 @@ class DurableTaggedTLog(TaggedTLog):
             if kind == _K_ENTRY:
                 _prev, version, tms = _dec_entry(payload)
                 entries[version] = tms
+                self._entry_bytes[version] = len(payload)
             elif kind == _K_EPOCH:
                 r = BinaryReader(payload)
                 self.locked_epoch = max(self.locked_epoch, r.u64())
@@ -139,6 +161,7 @@ class DurableTaggedTLog(TaggedTLog):
                 cur = self._popped_by_tag.get(tag, 0)
                 self._popped_by_tag[tag] = max(cur, v)
         self._entries = sorted(entries.items())
+        self._recount_mem()
         top = self._entries[-1][0] if self._entries else init_version
         self.version.set(max(top, init_version))
         self.durable.set(max(top, init_version))
@@ -170,6 +193,9 @@ class DurableTaggedTLog(TaggedTLog):
 
     def close(self) -> None:
         self.stop()
+        if self._spill is not None:
+            self._spill.close()
+            self._spill = None
         self.queue.close()
 
     # -- the commit path --
@@ -186,10 +212,11 @@ class DurableTaggedTLog(TaggedTLog):
             raise TLogStopped(f"locked by generation {self.locked_epoch}")
         if self.version.get() == prev_version:
             self._entries.append((version, mutations))
-            seq = self._push_blob(
-                _K_ENTRY, _enc_entry(prev_version, version, mutations)
-            )
+            blob = _enc_entry(prev_version, version, mutations)
+            seq = self._push_blob(_K_ENTRY, blob)
             self._entry_seq.append((version, seq))
+            self._entry_bytes[version] = len(blob)
+            self._mem_bytes += len(blob)
             self.version.set(version)
         if buggify("tlog_slow_fsync"):
             await current_loop().delay(
@@ -223,18 +250,135 @@ class DurableTaggedTLog(TaggedTLog):
                 TraceEvent("TLogCommitDurable").detail(
                     "Version", target
                 ).log()
+            # Spill from the GROUP-COMMIT actor, not the per-commit path
+            # (ref: the updateStorage background actor): a blocking btree
+            # fsync must never sit inside a client-visible commit() await.
+            self._maybe_spill()
+
+    # -- spill tier --
+    def _spill_store(self):
+        if self._spill is None:
+            from ..storage_engine.ssd_engine import KeyValueStoreSSD
+
+            self._spill = KeyValueStoreSSD(self._path_prefix + "_spill.btree")
+            # Stale content from a previous incarnation is just a cache of
+            # queue records that replay already rebuilt: start clean.
+            self._spill.clear_range(b"\x00" * 8, b"\xff" * 9)
+            self._spill.commit()
+        return self._spill
+
+    @staticmethod
+    def _vkey(version: int) -> bytes:
+        import struct
+
+        return struct.pack(">Q", version)
+
+    def _maybe_spill(self) -> None:
+        """Move the oldest DURABLE in-memory entries to the spill store
+        until memory is back under the knob. Only fsynced entries spill
+        (the store is a cache of the queue, so a spilled entry must
+        already be un-losable)."""
+        from ..core.knobs import SERVER_KNOBS
+
+        limit = SERVER_KNOBS.TLOG_SPILL_THRESHOLD
+        if self._mem_bytes <= limit:
+            return
+        d = self.durable.get()
+        spilled = 0
+        store = None
+        while self._mem_bytes > limit and len(self._entries) > 1:
+            version, tms = self._entries[0]
+            if version > d:
+                break  # not yet fsynced: must stay in memory
+            store = self._spill_store()
+            store.set(self._vkey(version), _enc_entry(0, version, tms))
+            self._entries.pop(0)
+            nb = self._entry_bytes.pop(version, 0)
+            self._mem_bytes -= nb
+            spilled += nb
+            self._spill_bytes_by_v[version] = nb
+            self.spilled_bytes += nb
+            self._spill_hi = max(self._spill_hi or 0, version)
+        if store is not None:
+            store.commit()
+            TraceEvent("TLogSpilled").detail("Bytes", spilled).detail(
+                "UpToVersion", self._spill_hi
+            ).detail("MemBytes", self._mem_bytes).log()
+
+    def _spilled_entries(self, from_version: int) -> list:
+        if self._spill is None or self._spill_hi is None:
+            return []
+        if from_version >= self._spill_hi:
+            return []
+        rows = self._spill.get_range(
+            self._vkey(from_version + 1), self._vkey(self._spill_hi) + b"\x00"
+        )
+        out = []
+        for _k, blob in rows:
+            _prev, version, tms = _dec_entry(blob)
+            out.append((version, tms))
+        return out
+
+    async def peek(self, from_version: int):
+        """MemoryTLog.peek merged with the spill tier: spilled entries are
+        always durable, in-memory ones filter on the durability cursor."""
+        if buggify("tlog_slow_peek"):
+            await current_loop().delay(
+                0.1 * current_loop().random.random01()
+            )
+        while True:
+            d = self.durable.get()
+            out = self._spilled_entries(from_version)
+            out += [e for e in self._entries if from_version < e[0] <= d]
+            if out:
+                return out
+            await self.durable.when_at_least(max(d, from_version) + 1)
+
+    def _drop_spilled_upto(self, version: int) -> None:
+        if self._spill is None or self._spill_hi is None:
+            return
+        self._spill.clear_range(b"\x00" * 8, self._vkey(version) + b"\x00")
+        self._spill.commit()
+        self._spill_bytes_by_v = {
+            v: b for v, b in self._spill_bytes_by_v.items() if v > version
+        }
+        self.spilled_bytes = sum(self._spill_bytes_by_v.values())
+        if version >= self._spill_hi:
+            self._spill_hi = None
+
+    def _drop_spilled_above(self, version: int) -> None:
+        if self._spill is None or self._spill_hi is None:
+            return
+        self._spill.clear_range(self._vkey(version) + b"\x00", b"\xff" * 9)
+        self._spill.commit()
+        self._spill_bytes_by_v = {
+            v: b for v, b in self._spill_bytes_by_v.items() if v <= version
+        }
+        self.spilled_bytes = sum(self._spill_bytes_by_v.values())
+        if self._spill_hi > version:
+            self._spill_hi = version if version > 0 else None
 
     # -- fences (both made durable) --
     def lock(self, epoch: int) -> int:
         d = super().lock(epoch)
+        self._recount_mem()  # the purge dropped non-durable entries
         w = BinaryWriter()
         w.u64(epoch).u64(d)
         self._push_blob(_K_EPOCH, w.to_bytes())
         self.queue.commit()
         return d
 
+    def _recount_mem(self) -> None:
+        live = {v for v, _ in self._entries}
+        self._entry_bytes = {
+            v: b for v, b in self._entry_bytes.items() if v in live
+        }
+        self._mem_bytes = sum(self._entry_bytes.values())
+
     def truncate_above(self, version: int) -> None:
         super().truncate_above(version)
+        self._recount_mem()
+        self._drop_spilled_above(version)
         self.entry_durable = min(self.entry_durable, version)
         w = BinaryWriter()
         w.u64(version)
@@ -256,6 +400,8 @@ class DurableTaggedTLog(TaggedTLog):
 
     def pop(self, upto_version: int) -> None:
         super().pop(upto_version)
+        self._recount_mem()
+        self._drop_spilled_upto(upto_version)
         # Release queue space: everything whose ENTRY starts before the
         # first kept version is reclaimable (file-granular underneath).
         keep_from = None

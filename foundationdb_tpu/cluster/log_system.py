@@ -170,12 +170,14 @@ class TagPartitionedLogSystem:
 
     def queue_bytes(self) -> int:
         """Un-popped payload held across logs (ratekeeper input, ref:
-        TLogQueueInfo)."""
+        TLogQueueInfo). SPILLED backlog counts too — the queue does not
+        shrink just because it moved to disk."""
         total = 0
         for log in self.logs:
             for _, tms in log._entries:
                 for tm in tms:
                     total += len(tm.mutation.param1) + len(tm.mutation.param2)
+            total += getattr(log, "spilled_bytes", 0)
         return total
 
 

@@ -21,6 +21,9 @@ from ..core.trace import TraceEvent
 class Ratekeeper:
     def __init__(self, tlog, storage):
         self.tlog = tlog
+        # Operator throttle (ref: fdbcli `throttle`): None = automatic
+        # only; a number caps the computed rate. Per-instance state.
+        self.manual_limit = None
         # One storage server or a fleet: the rate follows the WORST lag,
         # exactly like the reference's worst-queue selection (updateRate's
         # limiting storage server, Ratekeeper.actor.cpp:310-380).
@@ -67,6 +70,12 @@ class Ratekeeper:
 
     # -- control loop (ref: updateRate) --
     def _compute_rate(self) -> float:
+        auto = self._compute_rate_auto()
+        if self.manual_limit is not None:
+            return min(auto, float(self.manual_limit))
+        return auto
+
+    def _compute_rate_auto(self) -> float:
         raw = self._durable() - min(
             s.version.get() for s in self._live_storages()
         )

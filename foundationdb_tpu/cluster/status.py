@@ -75,7 +75,8 @@ def _sharded_status(cluster) -> dict[str, Any]:
             "id": i,
             "version": log.version.get(),
             "durable_version": log.durable.get(),
-            "queue_entries": len(log._entries),
+            "queue_entries": len(log._entries)
+            + getattr(log, "spilled_entries", 0),
         })
     durable = ls.durable_version()
     for s in cluster.storages:
@@ -157,7 +158,8 @@ def _local_status(cluster) -> dict[str, Any]:
             "version": tlog.version.get(),
             "durable_version": tlog.durable.get(),
             "popped_version": tlog.popped,
-            "queue_entries": len(tlog._entries),
+            "queue_entries": len(tlog._entries)
+            + getattr(tlog, "spilled_entries", 0),
         },
         {
             "role": "storage",

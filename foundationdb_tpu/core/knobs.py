@@ -138,6 +138,7 @@ class ServerKnobs(Knobs):
         # Backup / TaskBucket (ref: fdbclient/Knobs.cpp task bucket section)
         init("TASKBUCKET_CHECK_TIMEOUT_CHANCE", 0.02)
         init("TASKBUCKET_TIMEOUT_VERSIONS", 60 * 1_000_000)
+
         init("TASKBUCKET_MAX_PRIORITY", 1)
         init("BACKUP_SNAPSHOT_ROWS_PER_TASK", 1000)
         # Disk queue / storage engines
@@ -178,6 +179,8 @@ class ClientKnobs(Knobs):
         # Directory layer / HCA (ref: bindings directory allocator window)
         init("HCA_WINDOW_INITIAL_SIZE", 64)
         init("HCA_CANDIDATE_LIMIT", 4)
+        # Restore apply batching (wired: backup.restore chunk size)
+        init("RESTORE_WRITE_BATCH_ROWS", 500)
 
 
 SERVER_KNOBS = ServerKnobs()
