@@ -296,8 +296,15 @@ class DurableTaggedTLog(TaggedTLog):
             nb = self._entry_bytes.pop(version, 0)
             self._mem_bytes -= nb
             spilled += nb
-            self._spill_bytes_by_v[version] = nb
-            self.spilled_bytes += nb
+            # Backlog metrics count PAYLOAD bytes (same unit queue_bytes
+            # uses for in-memory entries), not encoded blob size — the
+            # ratekeeper input must not jump at the spill boundary.
+            payload = sum(
+                len(tm.mutation.param1) + len(tm.mutation.param2)
+                for tm in tms
+            )
+            self._spill_bytes_by_v[version] = payload
+            self.spilled_bytes += payload
             self._spill_hi = max(self._spill_hi or 0, version)
         if store is not None:
             store.commit()
@@ -305,13 +312,21 @@ class DurableTaggedTLog(TaggedTLog):
                 "UpToVersion", self._spill_hi
             ).detail("MemBytes", self._mem_bytes).log()
 
+    # Bounded per-peek read of the spill tier: a consumer catching up
+    # through a multi-GB spilled backlog must not re-materialize all of it
+    # in one call (that would undo the memory bound spilling exists for);
+    # it re-peeks from its advanced cursor, batch by batch.
+    SPILL_PEEK_BATCH = 1024
+
     def _spilled_entries(self, from_version: int) -> list:
         if self._spill is None or self._spill_hi is None:
             return []
         if from_version >= self._spill_hi:
             return []
         rows = self._spill.get_range(
-            self._vkey(from_version + 1), self._vkey(self._spill_hi) + b"\x00"
+            self._vkey(from_version + 1),
+            self._vkey(self._spill_hi) + b"\x00",
+            limit=self.SPILL_PEEK_BATCH,
         )
         out = []
         for _k, blob in rows:
@@ -329,6 +344,11 @@ class DurableTaggedTLog(TaggedTLog):
         while True:
             d = self.durable.get()
             out = self._spilled_entries(from_version)
+            if len(out) >= self.SPILL_PEEK_BATCH:
+                # Possibly-truncated spill read: more spilled versions may
+                # follow — appending in-memory entries here could skip the
+                # gap. The consumer re-peeks from its advanced cursor.
+                return out
             out += [e for e in self._entries if from_version < e[0] <= d]
             if out:
                 return out
