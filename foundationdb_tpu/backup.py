@@ -287,7 +287,14 @@ class ContinuousBackupAgent:
     """Continuous backup of a ShardedKVCluster into a container: an
     initial snapshot at a fence version, then the mutation log shipped as
     it commits. Any version >= the snapshot (up to the shipped frontier)
-    becomes restorable."""
+    becomes restorable.
+
+    Container choice: file:// and memory:// ops are in-process and cheap;
+    blobstore:// container ops are SYNCHRONOUS HTTP round trips that
+    block the loop for their duration — fine for operator tooling (CLI
+    backup/restore), but in-loop continuous shipping to a remote store
+    should land on a local container first (the reference likewise ships
+    through backup workers, not the commit path)."""
 
     def __init__(self, source, url: str, tag: int = BACKUP_TAG_BASE):
         from .backup_container import open_container

@@ -1,0 +1,224 @@
+"""Minimal async HTTP/1.1 client over the select() reactor (ref:
+fdbrpc/HTTP.actor.cpp — request/response with Content-Length bodies, the
+transport under the blobstore client).
+
+One request per connection (`Connection: close`), Content-Length bodies
+only — a response withOUT a Content-Length (or with chunked transfer
+encoding) is REFUSED rather than silently read as empty: the blobstore
+layer must never mistake a truncated reply for a zero-byte object. Real
+network only: the simulator exercises containers through memory://,
+exactly like the reference simulates blobstore with a local container.
+
+One protocol state machine (`_Exchange`) backs both forms:
+  - http_request       — awaitable, for actor call sites on a real-clock
+                         loop (uses the loop's reactor);
+  - http_request_sync  — for SYNC call sites already running ON the loop
+                         (the BackupContainer contract): pumps a private
+                         reactor, never re-entering the running loop.
+"""
+
+from __future__ import annotations
+
+import errno
+import socket
+from typing import Callable, Optional
+
+from ..core.errors import ConnectionFailed, TimedOut
+from ..core.runtime import Promise, current_loop
+
+
+class HTTPResponse:
+    def __init__(self, status: int, reason: str, headers: dict[str, str],
+                 body: bytes):
+        self.status = status
+        self.reason = reason
+        self.headers = headers
+        self.body = body
+
+
+def _build_request(method: str, host: str, path: str,
+                   headers: Optional[dict], body: bytes) -> bytes:
+    h = {"Host": host, "Content-Length": str(len(body)),
+         "Connection": "close"}
+    if headers:
+        h.update(headers)
+    lines = [f"{method} {path} HTTP/1.1"]
+    lines += [f"{k}: {v}" for k, v in h.items()]
+    return ("\r\n".join(lines) + "\r\n\r\n").encode() + body
+
+
+def _parse_head(raw: bytes) -> tuple[int, str, dict[str, str], int]:
+    head, _, _rest = raw.partition(b"\r\n\r\n")
+    lines = head.decode("latin-1").split("\r\n")
+    proto, _, rest = lines[0].partition(" ")
+    if not proto.startswith("HTTP/"):
+        raise ConnectionFailed(f"not an HTTP response: {lines[0]!r}")
+    code_s, _, reason = rest.partition(" ")
+    headers: dict[str, str] = {}
+    for ln in lines[1:]:
+        k, _, v = ln.partition(":")
+        headers[k.strip().lower()] = v.strip()
+    try:
+        code = int(code_s)
+    except ValueError:
+        raise ConnectionFailed(f"bad HTTP status line: {lines[0]!r}")
+    return code, reason, headers, len(head) + 4
+
+
+class _Exchange:
+    """One request/response over one connection, driven by reactor
+    callbacks; completion (HTTPResponse or exception) goes to `sink`
+    exactly once. EVERY callback is exception-contained: a malformed
+    response fails THIS exchange, never the reactor loop around it."""
+
+    def __init__(self, reactor, host: str, port: int, method: str,
+                 path: str, headers: Optional[dict], body: bytes,
+                 sink: Callable):
+        self.reactor = reactor
+        self.host, self.port = host, port
+        self.label = f"{method} {host}:{port}{path}"
+        self.out = _build_request(method, host, path, headers, body)
+        self.buf = b""
+        self.head = None
+        self.done = False
+        self.sink = sink
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.sock.setblocking(False)
+
+    def start(self) -> None:
+        try:
+            self.sock.connect((self.host, self.port))
+        except BlockingIOError:
+            pass
+        except OSError as e:
+            return self._finish(ConnectionFailed(str(e)))
+        self.reactor.register_write(self.sock.fileno(), self._on_writable)
+
+    def cancel(self, e: BaseException) -> None:
+        self._finish(e)
+
+    def _finish(self, outcome) -> None:
+        if self.done:
+            return
+        self.done = True
+        try:
+            self.reactor.unregister(self.sock.fileno())
+        except Exception:  # noqa: BLE001 - fd already closed
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        self.sink(outcome)
+
+    def _on_writable(self) -> None:
+        try:
+            err = self.sock.getsockopt(socket.SOL_SOCKET, socket.SO_ERROR)
+            if err:
+                return self._finish(ConnectionFailed(
+                    f"{self.label}: {errno.errorcode.get(err, err)}"
+                ))
+            try:
+                n = self.sock.send(self.out)
+            except (BlockingIOError, InterruptedError):
+                return
+            self.out = self.out[n:]
+            if not self.out:
+                self.reactor.unregister_write(self.sock.fileno())
+                self.reactor.register_read(self.sock.fileno(),
+                                           self._on_readable)
+        except BaseException as e:  # noqa: BLE001 - contain to the exchange
+            self._finish(e if isinstance(e, ConnectionFailed)
+                         else ConnectionFailed(f"{self.label}: {e}"))
+
+    def _on_readable(self) -> None:
+        try:
+            try:
+                chunk = self.sock.recv(1 << 16)
+            except (BlockingIOError, InterruptedError):
+                return
+            if chunk:
+                self.buf += chunk
+            if self.head is None and b"\r\n\r\n" in self.buf:
+                self.head = _parse_head(self.buf)
+                code, _reason, hdrs, _off = self.head
+                if "chunked" in hdrs.get("transfer-encoding", "").lower() \
+                        or ("content-length" not in hdrs and code != 204):
+                    raise ConnectionFailed(
+                        f"{self.label}: response without Content-Length "
+                        "(chunked/close-delimited bodies unsupported)"
+                    )
+            if self.head is not None:
+                code, reason, hdrs, off = self.head
+                need = int(hdrs.get("content-length", 0))
+                if len(self.buf) - off >= need:
+                    return self._finish(HTTPResponse(
+                        code, reason, hdrs, self.buf[off:off + need]
+                    ))
+            if not chunk:  # EOF before a complete response
+                raise ConnectionFailed(
+                    f"{self.label}: connection closed mid-response"
+                )
+        except BaseException as e:  # noqa: BLE001 - contain to the exchange
+            self._finish(e if isinstance(e, ConnectionFailed)
+                         else ConnectionFailed(f"{self.label}: {e}"))
+
+
+async def http_request(host: str, port: int, method: str, path: str,
+                       headers: Optional[dict] = None, body: bytes = b"",
+                       timeout: float = 30.0) -> HTTPResponse:
+    """One HTTP exchange; resolves with the full response or raises
+    ConnectionFailed/TimedOut."""
+    loop = current_loop()
+    reactor = getattr(loop, "reactor", None)
+    if reactor is None:
+        raise RuntimeError("http_request needs a real-clock loop+reactor")
+
+    done: Promise = Promise()
+
+    def sink(outcome) -> None:
+        if done.is_set():
+            return
+        if isinstance(outcome, BaseException):
+            done.send_error(outcome)
+        else:
+            done.send(outcome)
+
+    ex = _Exchange(reactor, host, port, method, path, headers, body, sink)
+    ex.start()
+
+    from ..core.actors import timeout as with_timeout
+
+    lost = object()
+    got = await with_timeout(done.future, timeout, lost)
+    if got is lost:
+        ex.cancel(TimedOut(ex.label))
+        raise TimedOut(f"HTTP {ex.label}")
+    return got
+
+
+def http_request_sync(host: str, port: int, method: str, path: str,
+                      headers: Optional[dict] = None, body: bytes = b"",
+                      timeout: float = 30.0) -> HTTPResponse:
+    """Synchronous form: drives its OWN private reactor to completion.
+    The outer loop's timers simply wait — container ops are short and the
+    caller is blocked on them anyway (long-running shipping should use
+    the async form)."""
+    import time as _time
+
+    from .reactor import SelectReactor
+
+    reactor = SelectReactor()
+    result: list = []
+    ex = _Exchange(reactor, host, port, method, path, headers, body,
+                   result.append)
+    ex.start()
+    deadline = _time.monotonic() + timeout
+    while not result:
+        if _time.monotonic() > deadline:
+            ex.cancel(TimedOut(ex.label))
+            raise TimedOut(f"HTTP {ex.label}")
+        reactor.poll(0.05)
+    if isinstance(result[0], BaseException):
+        raise result[0]
+    return result[0]

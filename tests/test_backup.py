@@ -126,11 +126,15 @@ def test_backup_containers_roundtrip(tmp_path, sim):
         await restore_from_container(db3, murl)
         assert await db3.get(b"a") == b"2"
 
-        # blobstore URLs parse (format check) but are gated: no egress.
+        # blobstore URLs parse and open (the S3-dialect client,
+        # exercised end-to-end in test_blobstore.py); malformed refuse.
         p = parse_blobstore_url("blobstore://k:s@host:443/bucket")
         assert p["bucket"] == "bucket"
+        assert open_container(
+            "blobstore://k:s@host:443/bucket"
+        ).bucket == "bucket"
         with _pytest.raises(ValueError):
-            open_container("blobstore://k:s@host:443/bucket")
+            parse_blobstore_url("blobstore://nope")
         c.stop(); c2.stop(); c3.stop()
 
     sim.run(main())
