@@ -78,7 +78,7 @@ class _Exchange:
         self.host, self.port = host, port
         self.label = f"{method} {host}:{port}{path}"
         self.out = _build_request(method, host, path, headers, body)
-        self.buf = b""
+        self.buf = bytearray()  # O(1) appends: bodies arrive in 64K chunks
         self.head = None
         self.done = False
         self.sink = sink
@@ -138,9 +138,9 @@ class _Exchange:
             except (BlockingIOError, InterruptedError):
                 return
             if chunk:
-                self.buf += chunk
+                self.buf.extend(chunk)
             if self.head is None and b"\r\n\r\n" in self.buf:
-                self.head = _parse_head(self.buf)
+                self.head = _parse_head(bytes(self.buf))
                 code, _reason, hdrs, _off = self.head
                 if "chunked" in hdrs.get("transfer-encoding", "").lower() \
                         or ("content-length" not in hdrs and code != 204):
@@ -153,7 +153,7 @@ class _Exchange:
                 need = int(hdrs.get("content-length", 0))
                 if len(self.buf) - off >= need:
                     return self._finish(HTTPResponse(
-                        code, reason, hdrs, self.buf[off:off + need]
+                        code, reason, hdrs, bytes(self.buf[off:off + need])
                     ))
             if not chunk:  # EOF before a complete response
                 raise ConnectionFailed(

@@ -368,10 +368,97 @@ def _apply_knobs(overrides: dict):
     return undo
 
 
+def run_restart_spec(spec: dict) -> dict[str, Any]:
+    """tests/restarting/ analogue: phase 1 runs its workloads on a
+    DURABLE cluster over a datadir, the incarnation shuts down, and
+    phase 2 boots a FRESH incarnation (new loop, new cluster object —
+    the restarted-binary seam) from the preserved datadir. The runner
+    fingerprints the full keyspace at the end of phase 1 and verifies
+    the rebooted cluster serves the identical state before phase 2's
+    workloads mutate it.
+
+    Spec: {"seed", "buggify", "cluster": {"kind": "restart", "engine",
+    "n_storage", ...}, "datadir": path, "phases": [{"workloads": [...]},
+    {"workloads": [...]}]}.
+    """
+    import hashlib
+    import tempfile
+
+    ckw = {k: v for k, v in spec.get("cluster", {}).items()
+           if k != "kind"}
+    if "shard_boundaries" in ckw:
+        # JSON specs carry boundaries as strings (same as run_spec).
+        ckw["shard_boundaries"] = [
+            b.encode() if isinstance(b, str) else b
+            for b in ckw["shard_boundaries"]
+        ]
+    datadir = spec.get("datadir") or tempfile.mkdtemp(prefix="fdbtpu_rs_")
+    results: dict[str, Any] = {"datadir": datadir, "phases": []}
+    fingerprint: list = [None]
+
+    async def _fingerprint(db) -> str:
+        async def read_all(tr):
+            return await tr.get_range(b"", b"\xff")
+
+        rows = await db.transact(read_all)
+        h = hashlib.sha256()
+        for k, v in rows:
+            # BOTH fields length-prefixed: the encoding must be injective
+            # or two different states could fingerprint identically.
+            h.update(b"%d:%b=%d:%b;" % (len(k), k, len(v), v))
+        return h.hexdigest()
+
+    for phase_idx, phase in enumerate(spec.get("phases", [])):
+        from ..core.trace import TraceSink, set_global_sink
+
+        set_global_sink(TraceSink())
+        undo_knobs = _apply_knobs(spec.get("knobs"))
+        loop = sim_loop(seed=spec.get("seed", 1) * 1000 + phase_idx,
+                        buggify=spec.get("buggify", False))
+        with loop_context(loop):
+            async def main():
+                from ..cluster.recovery import RecoverableShardedCluster
+
+                cluster = RecoverableShardedCluster(
+                    datadir=datadir, **ckw
+                ).start()
+                db = cluster.database()
+                carried_ok = True
+                if phase_idx > 0:
+                    # The restarted incarnation must serve the previous
+                    # incarnation's durable state bit-for-bit BEFORE any
+                    # new mutation.
+                    carried_ok = (await _fingerprint(db)) == fingerprint[0]
+                res = await _run_workloads(
+                    cluster, db, {"workloads": phase.get("workloads", [])}
+                )
+                fingerprint[0] = await _fingerprint(db)
+                cluster.stop()
+                res["state_carried"] = carried_ok
+                return res
+
+            try:
+                pres = loop.run(main(), timeout_sim_seconds=3600)
+            finally:
+                undo_knobs()
+        pres["sev_errors"] = len(global_sink().has_severity(40))
+        results["phases"].append(pres)
+
+    results["ok"] = all(
+        p.get("ok") and p.get("state_carried") and not p.get("sev_errors")
+        for p in results["phases"]
+    )
+    results["sev_errors"] = sum(p["sev_errors"] for p in results["phases"])
+    return results
+
+
 def run_spec(spec: dict) -> dict[str, Any]:
     """Run one spec in a fresh deterministic loop; returns results incl.
     per-workload metrics, overall ok, and the SevError count."""
     from ..core.trace import TraceSink, set_global_sink
+
+    if spec.get("cluster", {}).get("kind") == "restart":
+        return run_restart_spec(spec)
 
     # Fresh sink per spec: sev_errors must count THIS run only.
     set_global_sink(TraceSink())

@@ -1,0 +1,44 @@
+"""Restart-across-incarnations specs (tests/restarting/ analogue): a
+durable cluster runs workloads, shuts down, and a FRESH incarnation on
+the preserved datadir must serve the identical state (fingerprinted) and
+keep passing workloads."""
+
+import json
+import os
+
+import pytest
+
+from foundationdb_tpu.workloads.tester import run_spec
+
+pytestmark = pytest.mark.slow  # multi-minute tier (see pytest.ini)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.parametrize("engine", ["memory", "ssd"])
+def test_restart_spec_carries_state(tmp_path, engine):
+    result = run_spec({
+        "seed": 31,
+        "buggify": True,
+        "datadir": str(tmp_path / "data"),
+        "cluster": {"kind": "restart", "n_storage": 4, "n_logs": 2,
+                    "replication": "double", "engine": engine},
+        "phases": [
+            {"workloads": [
+                {"name": "Cycle", "nodes": 12, "clients": 2, "txns": 12},
+            ]},
+            {"workloads": [
+                {"name": "Cycle", "nodes": 12, "clients": 2, "txns": 12},
+            ]},
+        ],
+    })
+    assert result["ok"], json.dumps(result, default=str)[:1500]
+    assert all(p["state_carried"] for p in result["phases"])
+
+
+def test_checked_in_restart_spec(tmp_path):
+    with open(os.path.join(ROOT, "specs", "restart_cycle.json")) as f:
+        spec = json.load(f)
+    spec["datadir"] = str(tmp_path / "data")
+    result = run_spec(spec)
+    assert result["ok"], json.dumps(result, default=str)[:1500]
