@@ -344,32 +344,36 @@ class ContinuousBackupAgent:
         from .core.errors import ActorCancelled
         from .core.runtime import current_loop
 
+        # Retry wraps the WHOLE loop body, not just the container write: a
+        # peek() (or pop()) that throws — mid-recovery log fence, transport
+        # blip — used to kill this actor with ship_error unset, so
+        # wait_until() spun forever while the un-popped tag pinned the
+        # tlog's discard horizon and spill grew without bound. Any failure
+        # records ship_error and retries; progress clears it.
         while True:
-            entries = await self._view.peek(self.shipped_version)
-            for version, mutations in entries:
-                ms = [m for m in mutations
-                      if not m.param1.startswith(b"\xff")]
-                if ms:
-                    # A transient container failure (disk full, perm blip)
-                    # must not silently kill shipping while proxies keep
-                    # tagging mutations: retry, loudly.
-                    while True:
-                        try:
-                            self.container.write_file(
-                                _log_file_name(version),
-                                _enc_log_batch(version, ms),
-                            )
-                            break
-                        except ActorCancelled:
-                            raise
-                        except BaseException as e:  # noqa: BLE001
-                            self.ship_error = f"{type(e).__name__}: {e}"
-                            TraceEvent("BackupShipError",
-                                       severity=30).error(e).log()
-                            await current_loop().delay(0.5)
+            try:
+                entries = await self._view.peek(self.shipped_version)
+                for version, mutations in entries:
+                    ms = [m for m in mutations
+                          if not m.param1.startswith(b"\xff")]
+                    if ms:
+                        # A transient container failure (disk full, perm
+                        # blip) must not silently kill shipping while
+                        # proxies keep tagging mutations: retry, loudly.
+                        self.container.write_file(
+                            _log_file_name(version),
+                            _enc_log_batch(version, ms),
+                        )
+                    self.shipped_version = version
                     self.ship_error = None
-                self.shipped_version = version
-            self._view.pop(self.shipped_version)
+                self._view.pop(self.shipped_version)
+            except ActorCancelled:
+                raise
+            except BaseException as e:  # noqa: BLE001
+                self.ship_error = f"{type(e).__name__}: {e}"
+                TraceEvent("BackupShipError",
+                           severity=30).error(e).log()
+                await current_loop().delay(0.5)
 
     async def wait_until(self, version: int) -> None:
         from .core.runtime import current_loop
@@ -451,11 +455,18 @@ async def restore_to_version(db: Database, url: str, version: int) -> int:
         m = _re.match(r"logs/log-(\d+)\.fdblog$", name)
         if m and snap_v < int(m.group(1)) <= version:
             logs.append((int(m.group(1)), name))
-    for v, name in sorted(logs):
-        _ver, ms = _dec_log_batch(container.read_file(name))
-
-        async def apply(tr, ms=ms):
-            for m in ms:
+    # Replay chunked by count AND bytes like the snapshot path: one huge
+    # proxy batch (a bulk load that committed as a single version) must
+    # not exceed the transaction size limit and permanently wedge the
+    # restore. Mutations apply in order across chunks, and the whole
+    # multi-transaction replay runs under RESTORE_MARKER, so a torn
+    # replay is detectable exactly like a torn snapshot apply.
+    byte_budget = max(
+        1, int(CLIENT_KNOBS.TRANSACTION_SIZE_LIMIT) // 2
+    )
+    async def _apply_chunk(chunk: list) -> None:
+        async def apply(tr, chunk=chunk):
+            for m in chunk:
                 if m.type == MutationType.SET_VALUE:
                     tr.set(m.param1, m.param2)
                 elif m.type == MutationType.CLEAR_RANGE:
@@ -464,6 +475,22 @@ async def restore_to_version(db: Database, url: str, version: int) -> int:
                     tr.atomic_op(m.type, m.param1, m.param2)
 
         await db.transact(apply)
+
+    for v, name in sorted(logs):
+        _ver, ms = _dec_log_batch(container.read_file(name))
+        chunk: list = []
+        chunk_bytes = 0
+        for m in ms:
+            mbytes = len(m.param1) + len(m.param2)
+            if chunk and (len(chunk) >= batch
+                          or chunk_bytes + mbytes > byte_budget):
+                await _apply_chunk(list(chunk))
+                chunk.clear()
+                chunk_bytes = 0
+            chunk.append(m)
+            chunk_bytes += mbytes
+        if chunk:
+            await _apply_chunk(chunk)
 
     async def finish_body(tr):
         tr.options.set_access_system_keys()

@@ -124,33 +124,46 @@ async def _move_keys_fetch_finish(cluster, r, new_team, old_slices,
     # Fence version: everything at or below it will reach dests via
     # the snapshot; everything above arrives via their tag stream.
     # A no-op commit pushes the fence through the pipeline so the
-    # union tagging is in effect at v_f.
-    v_f = await _commit_fence(cluster)
-
-    # -- fetch: wait dests onto the stream, then snapshot each slice
-    #    at v_f from a surviving member of ITS old team --
+    # union tagging is in effect at v_f. The whole fence+snapshot step
+    # RETRIES with a fresh fence when the donor's MVCC window outran it
+    # (long stalls under attrition/recovery advance oldest_version past
+    # a fence taken before the stall — reading there would assert; the
+    # reference's fetchKeys likewise restarts on transaction_too_old).
+    from ..core.errors import OperationFailed
     from ..core.runtime import buggify, current_loop
 
-    if buggify("movekeys_slow_fetch"):
-        # The snapshot lags the fence: dests buffer a longer tail of the
-        # live stream before the base lands under it.
-        await current_loop().delay(0.1 * current_loop().random.random01())
-    for t in dests:
-        await cluster.storages[t].version.when_at_least(v_f)
-    if dests:
+    for _attempt in range(20):
+        v_f = await _commit_fence(cluster)
+
+        # -- fetch: wait dests onto the stream, then snapshot each slice
+        #    at v_f from a surviving member of ITS old team --
+        if buggify("movekeys_slow_fetch"):
+            # The snapshot lags the fence: dests buffer a longer tail of
+            # the live stream before the base lands under it.
+            await current_loop().delay(
+                0.1 * current_loop().random.random01()
+            )
+        for t in dests:
+            await cluster.storages[t].version.when_at_least(v_f)
+        if not dests:
+            break
         avoid = set(avoid_donors)
         all_rows: list = []
+        stale = False
         for b, e, team in old_slices:
             donors = [t for t in team if t not in avoid]
             if not donors:
-                from ..core.errors import OperationFailed
-
                 raise OperationFailed(
                     f"move_keys: no surviving donor for [{b!r}, {e!r})"
                 )
             donor = cluster.storages[min(donors)]
             await donor.version.when_at_least(v_f)
+            if v_f < donor.oldest_version:
+                stale = True  # window moved past the fence: re-fence
+                break
             all_rows.extend(donor.data.get_range(b, e, v_f))
+        if stale:
+            continue
         for t in dests:
             s = cluster.storages[t]
             # Snapshot beneath, buffered stream replayed on top.
@@ -159,6 +172,12 @@ async def _move_keys_fetch_finish(cluster, r, new_team, old_slices,
             # on a destination (ref: the fetched shard's readable
             # version gating in AddingShard).
             s.oldest_version = max(s.oldest_version, v_f)
+        break
+    else:
+        raise OperationFailed(
+            "move_keys: fence version kept falling below the donor MVCC "
+            "window (cluster too stalled to snapshot)"
+        )
 
     # -- finish: flip readability + the map --
     for t in new_team:

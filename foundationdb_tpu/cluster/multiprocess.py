@@ -228,10 +228,21 @@ def read_cluster_file(path: str) -> Optional[dict]:
 
 
 def _spec_kw(spec: dict) -> dict:
+    n_logs = spec.get("n_logs", 2)
+    n_log_hosts = spec.get("n_log_hosts", 1)
+    if n_log_hosts > n_logs:
+        # Caught at parse: a host owning zero logs would compute its
+        # durable floor as min() of nothing (crash) — or worse, report 0
+        # forever and pin the whole system's durability horizon there.
+        raise ValueError(
+            f"n_log_hosts={n_log_hosts} exceeds n_logs={n_logs}: every "
+            "log host must own at least one log (lower n_log_hosts or "
+            "raise n_logs)"
+        )
     return dict(
         n_storage=spec.get("n_storage", 4),
-        n_logs=spec.get("n_logs", 2),
-        n_log_hosts=spec.get("n_log_hosts", 1),
+        n_logs=n_logs,
+        n_log_hosts=n_log_hosts,
         n_resolvers=spec.get("n_resolvers", 1),
         replication=spec.get("replication", "double"),
         shard_boundaries=[
@@ -239,6 +250,9 @@ def _spec_kw(spec: dict) -> dict:
             for b in spec.get("shard_boundaries", [])
         ],
         seed=spec.get("seed", 1),
+        # Machine/DC topology (sim/topology.py): shapes the derived
+        # localities, so every host must parse it or team layouts diverge.
+        topology=spec.get("topology"),
     )
 
 
@@ -334,10 +348,13 @@ class LogHost:
             log.skip_to(req.version)
             return None
         if isinstance(req, TLogStatusRequest):
+            # SPILLED backlog counts too (mirrors log_system.queue_bytes):
+            # the un-popped queue does not shrink just because it moved to
+            # disk, and ratekeeper backpressure must keep seeing it.
             qbytes = sum(
                 len(tm.mutation.param1) + len(tm.mutation.param2)
                 for _, tms in log._entries for tm in tms
-            )
+            ) + getattr(log, "spilled_bytes", 0)
             return (log.version.get(), log.durable.get(), qbytes)
         if isinstance(req, TLogConfirmEpochRequest):
             return log.locked_epoch
@@ -457,7 +474,8 @@ class StorageHost:
         os.makedirs(datadir, exist_ok=True)
         kw = _spec_kw(spec)
         layout = derive_layout(kw["n_storage"], kw["replication"],
-                               kw["shard_boundaries"], kw["seed"])
+                               kw["shard_boundaries"], kw["seed"],
+                               topology=kw["topology"])
         self.storages = []
         self._tasks = ActorCollection()
         self.durability = DurabilityTracker(transport, log_addrs)
@@ -827,7 +845,7 @@ class TxnHost:
         self.shard_map = ShardMap(default_team=())
         for lo, hi, team in derive_layout(
             self.n_storage, kw["replication"], kw["shard_boundaries"],
-            kw["seed"],
+            kw["seed"], topology=kw["topology"],
         ):
             self.shard_map.set_team(KeyRange(lo, hi), team)
         if datadir is not None:

@@ -266,6 +266,12 @@ class CommitProxy:
         prev_version, version = self.master.get_commit_version()
         try:
             await self._commit_batch_impl(reqs, prev_version, version)
+        except GeneratorExit:
+            # Interpreter GC of a parked coroutine (a dead generation's
+            # batch collected during a LATER simulation run): not a
+            # commit failure, and logging it would pollute the current
+            # run's SevError count across run_spec boundaries.
+            raise
         except BaseException as e:
             # A wedged batch must never strand its clients or the batches
             # behind it. Nothing in this batch was reported committed, so

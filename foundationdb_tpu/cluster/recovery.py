@@ -349,6 +349,12 @@ class RecoverableShardedCluster:
         )
         self.inner = ShardedKVCluster(**sharded_kw)
         datadir = sharded_kw.get("datadir")
+        if sharded_kw.get("os_layer") is not None:
+            # Simulated-disk clusters (sim/topology.py power-loss tests):
+            # the NonDurableOS holds the log/engine files; coordinator
+            # registers stay in-memory — they model a separate, protected
+            # failure domain there (sim2's protectedAddresses).
+            datadir = None
         if datadir is not None:
             # Durable coordinators ride the same datadir: the generation
             # counter and its fencing promises must survive a process kill

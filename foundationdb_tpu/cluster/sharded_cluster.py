@@ -50,18 +50,18 @@ class ShardedKVCluster:
         n_proxies: int = 1,
         n_resolvers: int = 1,
         resolver_boundaries: Optional[Sequence[bytes]] = None,
+        topology: Optional[dict] = None,
+        os_layer=None,
     ):
         self.policy = policy_for_mode(replication)
-        self.replicas = [
-            Replica(
-                str(i),
-                LocalityData(
-                    processid=f"p{i}", zoneid=f"z{i}", machineid=f"m{i}",
-                    dcid=f"dc{i % 3}", data_hall=f"h{i % 3}",
-                ),
-            )
-            for i in range(n_storage)
-        ]
+        # `topology` ({"n_dcs", "machines_per_dc"}) switches localities to
+        # the machine/DC model (sim/topology.py): zone == machine, so the
+        # replication policy places each team across distinct MACHINES and
+        # a machine kill can never take a whole team with it — exactly the
+        # reference's default zone=machine failure domain.
+        self.topology = topology
+        self.replicas = build_replicas(n_storage, topology)
+        self.os_layer = os_layer
         # Durable tier (ref: worker.actor.cpp recruiting tlog/storage over
         # their on-disk files): with a datadir every tlog rides a DiskQueue
         # (fsync on the commit path) and every storage server flushes into
@@ -73,12 +73,14 @@ class ShardedKVCluster:
 
             from .durable_tlog import DurableTaggedTLog
 
-            _os.makedirs(datadir, exist_ok=True)
+            if os_layer is None:
+                _os.makedirs(datadir, exist_ok=True)
             log_factory = lambda i: DurableTaggedTLog(  # noqa: E731
-                f"{datadir}/log{i}"
+                f"{datadir}/log{i}", os_layer=os_layer
             )
             engines = [
-                _make_engine(engine, f"{datadir}/storage{i}")
+                _make_engine(engine, f"{datadir}/storage{i}",
+                             os_layer=os_layer)
                 for i in range(n_storage)
             ]
         else:
@@ -98,7 +100,7 @@ class ShardedKVCluster:
         #    booted role hosts (multi-process deployment) agree on the
         #    topology without exchanging it. --
         layout = derive_layout(n_storage, replication, shard_boundaries,
-                               seed)
+                               seed, topology=topology)
         self.shard_map = ShardMap(default_team=())
         for s in self.storages:
             s.owned = _all_false_map()
@@ -340,27 +342,56 @@ def close_durable_tier(storages, logs) -> None:
         log.close()
 
 
+def build_replicas(
+    n_storage: int, topology: Optional[dict] = None
+) -> list[Replica]:
+    """Per-storage localities — one definition shared by the cluster and
+    derive_layout so placement stays a pure function of the spec.
+
+    Without a topology this is the historical per-server layout (every
+    server its own zone/machine, DCs round-robined by 3). With one, zone
+    and machine collapse to the hosting SimMachine: storage i lives on
+    machine i % n_machines, machine m in DC m % n_dcs — the shape
+    sim/topology.py's shared-fate kills operate on."""
+    if topology is None:
+        return [
+            Replica(
+                str(i),
+                LocalityData(
+                    processid=f"p{i}", zoneid=f"z{i}", machineid=f"m{i}",
+                    dcid=f"dc{i % 3}", data_hall=f"h{i % 3}",
+                ),
+            )
+            for i in range(n_storage)
+        ]
+    n_dcs = int(topology.get("n_dcs", 1))
+    n_machines = n_dcs * int(topology.get("machines_per_dc", 3))
+    out = []
+    for i in range(n_storage):
+        m = i % n_machines
+        out.append(Replica(
+            str(i),
+            LocalityData(
+                processid=f"p{i}", zoneid=f"m{m}", machineid=f"m{m}",
+                dcid=f"dc{m % n_dcs}", data_hall=f"h{m % n_dcs}",
+            ),
+        ))
+    return out
+
+
 def derive_layout(
     n_storage: int,
     replication: str = "double",
     shard_boundaries: Optional[Sequence[bytes]] = None,
     seed: int = 1,
+    topology: Optional[dict] = None,
 ) -> list[tuple[bytes, bytes, tuple]]:
     """The initial (lo, hi, team) assignment for every shard — a pure
     function of the deployment spec, shared by the in-process cluster and
     the multi-process role hosts (each host derives the same topology
     independently)."""
     policy = policy_for_mode(replication)
-    replicas = [
-        Replica(
-            str(i),
-            LocalityData(
-                processid=f"p{i}", zoneid=f"z{i}", machineid=f"m{i}",
-                dcid=f"dc{i % 3}", data_hall=f"h{i % 3}",
-            ),
-        )
-        for i in range(n_storage)
-    ]
+    replicas = build_replicas(n_storage, topology)
     rand = DeterministicRandom(seed)
     edges = [b""] + list(shard_boundaries or []) + [KEYSPACE_END]
     out = []
@@ -375,16 +406,22 @@ def derive_layout(
     return out
 
 
-def _make_engine(kind: str, path: str):
+def _make_engine(kind: str, path: str, os_layer=None):
     """IKeyValueStore selection (ref: the ssd/memory storeType knob,
     worker.actor.cpp openKVStore)."""
     if kind == "memory":
         from ..storage_engine.memory_engine import KeyValueStoreMemory
 
-        return KeyValueStoreMemory(path)
+        return KeyValueStoreMemory(path, os_layer=os_layer)
     if kind == "ssd":
         from ..storage_engine.ssd_engine import KeyValueStoreSSD
 
+        if os_layer is not None:
+            raise ValueError(
+                "ssd engine does not take a simulated os_layer (the "
+                "native btree does its own IO); use engine='memory' for "
+                "power-loss simulation"
+            )
         return KeyValueStoreSSD(path + ".btree")
     raise ValueError(f"unknown storage engine {kind!r}")
 

@@ -364,7 +364,11 @@ class FusedLayout:
       q_end    R       sorted position of each read's end endpoint
       s_begin  Wr      sorted position of each write's begin endpoint
       s_end    Wr      sorted position of each write's end endpoint
-      tmeta    T       rcount | wcount<<13 | too_old<<26   per txn
+      tmeta    T       rcount | wcount<<15 | too_old<<30   per txn
+                       (15-bit counts: a single legal transaction can
+                       carry ~10k ranges, which overflowed the original
+                       13-bit fields; bit 31 stays clear so the int32 is
+                       never negative)
       tsnap    T       read snapshot as offset from the batch base
       scalars  4       [version_off, oldest_off, n_reads, n_writes]
 
@@ -589,15 +593,15 @@ def pack_batch(
     wcount = np.bincount(
         np.asarray(w_txn, dtype=np.int64), minlength=T
     ).astype(np.int64) if nw else np.zeros(T, np.int64)
-    if rcount.max(initial=0) > 0x1FFF or wcount.max(initial=0) > 0x1FFF:
+    if rcount.max(initial=0) > 0x7FFF or wcount.max(initial=0) > 0x7FFF:
         raise ValueError(
-            "a transaction exceeds 8191 conflict ranges of one kind "
+            "a transaction exceeds 32767 conflict ranges of one kind "
             "(chunk the batch; see SERVER_KNOBS.TPU_MAX_CHUNK_RANGES)"
         )
     too_old_arr = np.zeros(T, np.int64)
     too_old_arr[:n_txns] = np.asarray(too_old_l, dtype=np.int64)
     buf[lay.off_tmeta : lay.off_tmeta + T] = (
-        rcount | (wcount << 13) | (too_old_arr << 26)
+        rcount | (wcount << 15) | (too_old_arr << 30)
     ).astype(np.int32)
     if n_txns:
         snaps = np.fromiter(

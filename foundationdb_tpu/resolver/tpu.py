@@ -274,9 +274,9 @@ def _resolve_kernel_impl(hmat, n, fused, *, lay: FusedLayout):
 
     # Per-row txn ids from per-txn counts; rows outside the live prefix
     # resolve to harmless values (snapshot +inf, validity False).
-    rcount = tmeta & 0x1FFF
-    wcount = (tmeta >> 13) & 0x1FFF
-    too_old = ((tmeta >> 26) & 1).astype(bool)
+    rcount = tmeta & 0x7FFF
+    wcount = (tmeta >> 15) & 0x7FFF
+    too_old = ((tmeta >> 30) & 1).astype(bool)
 
     def row_txn(counts, size):
         starts = jnp.cumsum(counts) - counts

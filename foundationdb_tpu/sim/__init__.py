@@ -5,3 +5,8 @@ fdbrpc/simulator.h; SURVEY §4 tier 2 — "the backbone")."""
 
 from .network import RemoteStream, SimNetwork, SimProcess  # noqa: F401
 from .harness import SimulatedCluster  # noqa: F401
+from .topology import (  # noqa: F401
+    MachineTopology,
+    SimDatacenter,
+    SimMachine,
+)

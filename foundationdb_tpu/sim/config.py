@@ -11,6 +11,9 @@ seed to a tester spec (workloads/tester.run_spec input), covering
 
   - cluster kind + role counts (storage 3-6, logs 1-3),
   - replication mode, constrained by the fleet size,
+  - a machine/DC topology (sim/topology.py) about half the time —
+    DC count, machines per DC — which upgrades the attrition draw to
+    the machine-level nemesis (shared-fate kills, swizzles, DC kills),
   - a randomized subset of knob overrides (batch sizing, shard
     thresholds, lease/heartbeat timing — knobs the repo actually uses),
   - a workload mix: one correctness core (Cycle) plus fault/adversary
@@ -47,6 +50,21 @@ def generate_config(seed: int) -> dict[str, Any]:
     n_storage = rng.randint(3, 6)
     n_logs = rng.randint(1, 3)
     replication = rng.choice(_REPLICATION_FOR[min(n_storage, 3)])
+
+    # Machine/DC topology (sim/topology.py), drawn per seed like the
+    # reference's machine/datacenter counts (SimulatedCluster's
+    # datacenters/machineCount randomization): zone==machine localities,
+    # so teams spread across machines and machine kills stay survivable.
+    # Needs at least as many machines as the replication factor or the
+    # policy is unsatisfiable by construction.
+    topology = None
+    if rng.random() < 0.5:
+        n_dcs = rng.choice([1, 1, 2, 3])
+        machines_per_dc = rng.randint(2, 4)
+        need = {"single": 1, "double": 2, "triple": 3}[replication]
+        while n_dcs * machines_per_dc < need:
+            machines_per_dc += 1
+        topology = {"n_dcs": n_dcs, "machines_per_dc": machines_per_dc}
 
     knobs: dict[str, Any] = {}
     for name, reg, (lo, hi) in _KNOB_RANGES:
@@ -89,24 +107,43 @@ def generate_config(seed: int) -> dict[str, Any]:
         })
         workloads.append({"name": "DataDistribution"})
     if attrition:
-        workloads.append({"name": "Attrition",
-                          "interval": round(0.5 + rng.random(), 2),
-                          "kills": rng.randint(1, 3)})
+        if topology is not None and replication != "single":
+            # With a machine topology, attrition upgrades to the
+            # machine/DC nemesis: shared-fate kills, swizzled clogs, and
+            # (multi-DC shapes only) a whole-datacenter kill, all gated
+            # by the quorum-safety check.
+            workloads.append({
+                "name": "MachineAttrition",
+                "interval": round(0.5 + rng.random(), 2),
+                "kills": rng.randint(1, 2),
+                "reboots": rng.randint(0, 1),
+                "swizzles": rng.randint(0, 1),
+                "dc_kills": 1 if (topology["n_dcs"] > 1
+                                  and rng.random() < 0.5) else 0,
+                "outage": round(0.2 + 0.4 * rng.random(), 2),
+            })
+        else:
+            workloads.append({"name": "Attrition",
+                              "interval": round(0.5 + rng.random(), 2),
+                              "kills": rng.randint(1, 3)})
     if rng.random() < 0.5 and replication != "single":
         workloads.append({"name": "RebootStorage",
                           "reboots": rng.randint(1, 3),
                           "interval": round(0.4 + rng.random(), 2)})
 
+    cluster: dict[str, Any] = {
+        "kind": "recoverable_sharded",
+        "n_storage": n_storage,
+        "n_logs": n_logs,
+        "replication": replication,
+    }
+    if topology is not None:
+        cluster["topology"] = topology
     return {
         "seed": seed,
         "buggify": True,
         "knobs": knobs,
-        "cluster": {
-            "kind": "recoverable_sharded",
-            "n_storage": n_storage,
-            "n_logs": n_logs,
-            "replication": replication,
-        },
+        "cluster": cluster,
         "workloads": workloads,
     }
 

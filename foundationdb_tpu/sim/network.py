@@ -44,6 +44,10 @@ class SimNetwork:
         self.base_latency = base_latency
         self.jitter = jitter
         self._clogged_until: dict[tuple[str, str], float] = {}
+        # Per-PROCESS clogs (all links of the process, both directions):
+        # the unit of sim2's clogInterface and of swizzled clogging, where
+        # a machine's whole interface goes dark and unclogs later.
+        self._proc_clogged_until: dict[str, float] = {}
         self._partitioned: set[frozenset] = set()
         self.messages_sent = 0
         self.messages_dropped = 0
@@ -59,6 +63,66 @@ class SimNetwork:
         TraceEvent("SimClogPair").detail("A", a.name).detail(
             "B", b.name
         ).detail("Seconds", seconds).log()
+
+    def clog_process(self, p: SimProcess, seconds: float) -> None:
+        """Clog EVERY link of `p` (ref: clogInterface,
+        sim2.actor.cpp:1454): messages to or from it are held until the
+        clog lifts (or unclog_process cuts it short)."""
+        until = current_loop().now() + seconds
+        self._proc_clogged_until[p.name] = max(
+            self._proc_clogged_until.get(p.name, 0.0), until
+        )
+        TraceEvent("SimClogProcess").detail("Process", p.name).detail(
+            "Seconds", seconds
+        ).log()
+
+    def unclog_process(self, p: SimProcess) -> None:
+        """Lift a process clog immediately (the swizzle's random-order
+        unclog step needs explicit lifting, not just expiry)."""
+        if self._proc_clogged_until.pop(p.name, None) is not None:
+            TraceEvent("SimUnclogProcess").detail("Process", p.name).log()
+
+    def clog_pair_sets(self, aprocs, bprocs, seconds: float) -> None:
+        """Clog every link between two process SETS — the machine-pair and
+        DC-pair clog (ref: sim2's clogPair over machine addresses): two
+        machines (or datacenters) lose sight of each other while each
+        keeps talking to everyone else."""
+        for a in aprocs:
+            for b in bprocs:
+                if a.name != b.name:
+                    self.clog_pair(a, b, seconds)
+
+    async def swizzle_clog(self, proc_sets, random, max_clog: float = 2.0):
+        """The reference's SWIZZLED clogging (ref: RandomClogging.actor.cpp
+        swizzleClog): clog all links of a random subset of machines
+        (each `proc_sets` entry is one machine's processes), then unclog
+        in a DIFFERENT random order, staggered — the overlap windows
+        produce partial-connectivity states plain pair clogs never reach.
+        """
+        from ..core.runtime import current_loop
+
+        loop = current_loop()
+        chosen = [ps for ps in proc_sets if random.random01() < 0.5]
+        if not chosen:
+            chosen = [proc_sets[random.random_int(0, len(proc_sets))]]
+        for ps in chosen:
+            for p in ps:
+                # Long enough to outlive the swizzle; lifted explicitly.
+                self.clog_process(p, 1000.0 + max_clog)
+            await loop.delay(max_clog * random.random01() * 0.3)
+        order = list(chosen)
+        # Fisher-Yates off the deterministic PRNG: the unclog order is
+        # part of the seed's schedule.
+        for i in range(len(order) - 1, 0, -1):
+            j = random.random_int(0, i + 1)
+            order[i], order[j] = order[j], order[i]
+        for ps in order:
+            await loop.delay(max_clog * random.random01() * 0.7)
+            for p in ps:
+                self.unclog_process(p)
+        TraceEvent("SimSwizzleDone").detail(
+            "Machines", len(chosen)
+        ).log()
 
     def partition(self, a: SimProcess, b: SimProcess) -> None:
         self._partitioned.add(frozenset((a.name, b.name)))
@@ -87,7 +151,13 @@ class SimNetwork:
         """Schedule fn() on the destination after simulated network delay;
         silently dropped under blackout/partition (the sender learns only
         via its own timeouts, as on a real network)."""
-        loop = current_loop()
+        try:
+            loop = current_loop()
+        except RuntimeError:
+            # Loop torn down (test shutdown GC-ing parked reply relays):
+            # the network is gone with it, the message just drops.
+            self.messages_dropped += 1
+            return
         self.messages_sent += 1
         if not src.alive or not dst.alive or (
             frozenset((src.name, dst.name)) in self._partitioned
@@ -95,7 +165,11 @@ class SimNetwork:
             self.messages_dropped += 1
             return
         delay = self._latency()
-        clog = self._clogged_until.get((src.name, dst.name), 0.0)
+        clog = max(
+            self._clogged_until.get((src.name, dst.name), 0.0),
+            self._proc_clogged_until.get(src.name, 0.0),
+            self._proc_clogged_until.get(dst.name, 0.0),
+        )
         if clog > loop.now():
             delay += clog - loop.now()
 

@@ -119,11 +119,22 @@ class NonDurableOS:
         self._fds.pop(fd, None)
 
     # -- the havoc (ref: AsyncFileNonDurable's kill behavior) --
-    def kill(self) -> dict:
+    def kill(self, prefixes=None) -> dict:
         """The machine dies: every pending page is dropped, kept, or
-        corrupted by seeded coin flip; open fds are gone."""
+        corrupted by seeded coin flip; open fds are gone.
+
+        `prefixes` scopes the power loss to one MACHINE of a topology
+        (sim/topology.py): only files whose path starts with one of the
+        prefixes lose their pending pages — other machines' disks are a
+        different failure domain and keep theirs. Open fds are cleared
+        for the killed files only."""
         stats = {"dropped": 0, "kept": 0, "corrupted": 0}
-        for f in self.files.values():
+        victims = {
+            path: f for path, f in self.files.items()
+            if prefixes is None
+            or any(path.startswith(p) for p in prefixes)
+        }
+        for f in victims.values():
             for idx, page in list(f.pending.items()):
                 roll = self.random.random01()
                 if roll < self.drop_prob:
@@ -142,7 +153,9 @@ class NonDurableOS:
                 f.durable_size,
                 max(((i + 1) * PAGE for i in f.durable), default=0),
             )
-        self._fds.clear()
+        killed = set(map(id, victims.values()))
+        for fd in [fd for fd, f in self._fds.items() if id(f) in killed]:
+            del self._fds[fd]
         self.kills += 1
         return stats
 

@@ -1,0 +1,437 @@
+"""Machine/datacenter fault topology: shared-fate kills over the
+simulated cluster (ref: fdbrpc/sim2.actor.cpp — machines own processes
+and kills operate on machines, killProcess_internal :1217, killMachine
+:1355, killDataCenter :1417; protectedAddresses :358 routes kills around
+the coordinators; SimulatedCluster.actor.cpp places roles onto machines
+per datacenter).
+
+Before this tier, faults were per-ROLE (kill the transaction system,
+reboot one storage server): a resolver and a tlog co-located on a dying
+host could never fail TOGETHER, which is exactly the scenario class that
+shakes out shared-fate bugs. Here the cluster's components are placed
+onto `SimMachine`s grouped into `SimDatacenter`s, and faults operate on
+the machines:
+
+- `kill_machine`   blackout every resident process at one instant: the
+                   machine's storage servers stop serving and pulling,
+                   its network process drops traffic both ways, and any
+                   co-resident transaction-system role (or tlog) takes
+                   the whole generation down with it.
+- `reboot_machine` clean restart (state preserved — sim2's reboot) or
+                   POWER-LOSS restart: the machine's un-fsynced disk
+                   pages are dropped/kept/corrupted by seeded coin flip
+                   (sim/nondurable.py) and its tlog + storage engine are
+                   rebuilt from whatever the disk kept, followed by a
+                   full recovery (a cold boot IS a recovery).
+- `kill_datacenter`every non-protected machine of one DC at one instant.
+- swizzle/clogs    sim/network.py's machine-pair, DC-pair and swizzled
+                   clogging over the machines' processes.
+
+Placement mirrors cluster/sharded_cluster.build_replicas: storage tag t
+lives on machine t % n_machines, machine m in DC m % n_dcs, and zone ==
+machine — so the replication policy has already spread every team across
+machines and a single machine kill can never eat a whole team. Tlog i
+shares machine i % n_machines with its storage neighbour (deliberate
+shared fate); the per-generation transaction roles live on one machine
+and are re-placed onto a live machine by each recovery; coordinators sit
+on the last machine of each DC and make those machines PROTECTED — the
+analogue of sim2's protectedAddresses, which kills must route around.
+
+In-process limits (documented, not hidden): role-to-role traffic does
+not cross the SimNetwork (the reference's intra-machine traffic is
+near-free too), so clogs and swizzles act on the client<->cluster hops;
+and a killed tlog keeps its in-memory state (kill == blackout), because
+full log-server loss is the log-replication tier's subject — see
+sim/network.py's module docstring for the same contract.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import Optional
+
+from ..core.runtime import current_loop
+from ..core.trace import TraceEvent
+from .network import SimNetwork, SimProcess
+
+
+class SimMachine:
+    """One failure domain: a set of processes that die at one instant
+    (ref: sim2's MachineInfo — processes, machineId, and the machine-wide
+    kill entry points)."""
+
+    def __init__(self, index: int, dc: "SimDatacenter"):
+        self.index = index
+        self.name = f"m{index}"
+        self.dc = dc
+        self.proc = SimProcess(self.name)
+        self.storage_tags: list[int] = []
+        self.log_ids: list[int] = []
+        self.has_txn = False
+        self.coordinator_ids: list[int] = []
+        self.alive = True
+        self.kills = 0
+
+    @property
+    def protected(self) -> bool:
+        """Machines hosting coordinators are never killed (ref: sim2's
+        protectedAddresses — the simulator must not destroy the quorum
+        that arbitrates recovery)."""
+        return bool(self.coordinator_ids)
+
+    def __repr__(self):
+        roles = []
+        if self.storage_tags:
+            roles.append(f"storage{self.storage_tags}")
+        if self.log_ids:
+            roles.append(f"log{self.log_ids}")
+        if self.has_txn:
+            roles.append("txn")
+        if self.coordinator_ids:
+            roles.append("coord")
+        return (f"SimMachine({self.name}@{self.dc.name}, "
+                f"{'+'.join(roles) or 'idle'}, "
+                f"{'up' if self.alive else 'DOWN'})")
+
+
+class SimDatacenter:
+    def __init__(self, index: int):
+        self.index = index
+        self.name = f"dc{index}"
+        self.machines: list[SimMachine] = []
+
+    def __repr__(self):
+        return f"SimDatacenter({self.name}, {len(self.machines)} machines)"
+
+
+class _RoutedStream:
+    """A stream endpoint viewed across the simulated network with a
+    LATE-BOUND destination: the transaction system migrates to a new
+    machine on every recovery, so the client's grv/commit hop must
+    resolve its destination per send (the RemoteStream contract
+    otherwise — request forwarded through the network, reply relayed
+    back the same way)."""
+
+    def __init__(self, net: SimNetwork, src: SimProcess, dst_fn, stream_fn):
+        self.net = net
+        self.src = src
+        self.dst_fn = dst_fn
+        self.stream_fn = stream_fn
+
+    def send(self, req) -> None:
+        from ..core.runtime import Promise
+
+        dst = self.dst_fn()
+        stream = self.stream_fn()
+        client_reply = req.reply
+        server_req = replace(req, reply=Promise())
+
+        def relay_back(f):
+            def complete():
+                if client_reply.is_set():
+                    return
+                if f.is_error():
+                    client_reply.send_error(f._value)
+                else:
+                    client_reply.send(f._value)
+
+            self.net.deliver(dst, self.src, complete)
+
+        server_req.reply.future.add_callback(relay_back)
+        self.net.deliver(self.src, dst, lambda: stream.send(server_req))
+
+
+class MachineTopology:
+    """The machine/DC layout of one simulated cluster plus the fault
+    arsenal that exploits it. Built by workloads/tester.run_spec when the
+    cluster spec carries a "topology" stanza; all randomness flows from
+    the deterministic loop PRNG, so the same seed replays the same kill
+    schedule."""
+
+    def __init__(self, cluster, n_dcs: int = 1, machines_per_dc: int = 3,
+                 net: Optional[SimNetwork] = None, disk=None,
+                 engine: str = "memory"):
+        self.cluster = cluster
+        self.net = net if net is not None else SimNetwork()
+        self.disk = disk            # NonDurableOS when power loss is in play
+        self.engine_kind = engine
+        self.n_dcs = int(n_dcs)
+        self.machines_per_dc = int(machines_per_dc)
+        self.client_proc = SimProcess("client")
+        self.protected_kill_attempts = 0
+
+        self.dcs = [SimDatacenter(d) for d in range(self.n_dcs)]
+        n_machines = self.n_dcs * self.machines_per_dc
+        self.machines = []
+        for m in range(n_machines):
+            dc = self.dcs[m % self.n_dcs]
+            machine = SimMachine(m, dc)
+            dc.machines.append(machine)
+            self.machines.append(machine)
+
+        # -- role placement (must mirror build_replicas for storages) --
+        for t in range(len(cluster.storages)):
+            self.machines[t % n_machines].storage_tags.append(t)
+        for i in range(len(cluster.log_system.logs)):
+            self.machines[i % n_machines].log_ids.append(i)
+        # Coordinators on the LAST machine of each DC (wrapping): spread
+        # across failure domains, away from the low-index machines that
+        # host the killable roles. Small fleets CO-LOCATE coordinators
+        # instead of spreading — protecting all but one machine would
+        # leave the nemesis nothing to kill (the reference's simulated
+        # clusters likewise bound protectedAddresses to a machine subset).
+        coords = getattr(cluster, "coordinators", [])
+        if coords:
+            n_protected = min(len(coords), max(1, n_machines - 2))
+            slots: list[SimMachine] = []
+            k = 0
+            while len(slots) < n_protected and k < 4 * n_machines:
+                dc = self.dcs[k % self.n_dcs]
+                m = dc.machines[-1 - (k // self.n_dcs) % len(dc.machines)]
+                if m not in slots:
+                    slots.append(m)
+                k += 1
+            for ci in range(len(coords)):
+                slots[ci % len(slots)].coordinator_ids.append(ci)
+        # Per-generation transaction roles start on machine 0 and are
+        # re-placed by every recovery (hook below).
+        self.txn_machine = self.machines[0]
+        self.txn_machine.has_txn = True
+        self._install_recovery_hook()
+        TraceEvent("SimTopologyBuilt").detail("Machines", n_machines).detail(
+            "DCs", self.n_dcs
+        ).detail(
+            "Protected", sum(1 for m in self.machines if m.protected)
+        ).log()
+
+    # -- wiring --
+    def _install_recovery_hook(self) -> None:
+        cluster = self.cluster
+        orig = getattr(cluster, "_recover", None)
+        if orig is None:
+            return
+
+        def recover_and_place():
+            orig()
+            self._place_txn_roles()
+
+        cluster._recover = recover_and_place
+
+    def _place_txn_roles(self) -> None:
+        """Each recovery recruits the new generation's roles onto a LIVE
+        machine (ref: the cluster controller recruiting on available
+        workers) — deterministically the lowest-index live machine, so
+        the same seed re-places identically."""
+        for m in self.machines:
+            m.has_txn = False
+        target = next((m for m in self.machines if m.alive),
+                      self.machines[0])
+        target.has_txn = True
+        self.txn_machine = target
+        TraceEvent("SimTxnRolesPlaced").detail("Machine", target.name).log()
+
+    def machine_of_tag(self, tag: int) -> SimMachine:
+        return self.machines[tag % len(self.machines)]
+
+    def database(self):
+        """A client database whose every hop crosses the SimNetwork from
+        the client's process to the destination machine's process — so
+        machine blackouts, clogs and swizzles act on real traffic (the
+        role endpoints are already streams; only the transport changes)."""
+        from ..client.connection import ShardedConnection
+        from ..client.database import Database
+
+        cluster = self.cluster
+        if not hasattr(cluster, "grv_ref"):
+            raise ValueError(
+                "MachineTopology.database() needs a recoverable cluster "
+                "(EndpointRefs to follow recoveries)"
+            )
+        route = lambda dst_fn, stream_fn: _RoutedStream(  # noqa: E731
+            self.net, self.client_proc, dst_fn, stream_fn
+        )
+        txn_proc = lambda: self.txn_machine.proc  # noqa: E731
+        conn = ShardedConnection(
+            route(txn_proc, lambda: cluster.grv_ref),
+            route(txn_proc, lambda: cluster.commit_ref),
+            route(txn_proc, lambda: cluster.location_ref),
+            {
+                s.tag: route(
+                    lambda t=s.tag: self.machine_of_tag(t).proc,
+                    lambda t=s.tag: cluster.storages[t].read_stream,
+                )
+                for s in cluster.storages
+            },
+        )
+        return Database(cluster, conn=conn)
+
+    # -- quorum safety --
+    def can_kill(self, machines) -> bool:
+        """True iff killing `machines` (on top of the already-dead ones)
+        stays inside what the configured replication mode can survive:
+        every shard team keeps at least one live replica, and at least
+        one machine stays up to host the re-recruited transaction roles.
+        The attrition nemesis gates every kill on this — the simulator
+        must drive the cluster to the edge, never over it."""
+        dead = {m.index for m in self.machines if not m.alive}
+        dead |= {m.index for m in machines}
+        if all(m.index in dead for m in self.machines):
+            return False
+        n = len(self.machines)
+        for _b, _e, team in self.cluster.shard_map.ranges():
+            if team and all(t % n in dead for t in team):
+                return False
+        return True
+
+    def killable_machines(self) -> list[SimMachine]:
+        return [
+            m for m in self.machines
+            if m.alive and not m.protected and self.can_kill([m])
+        ]
+
+    # -- the fault arsenal --
+    def kill_machine(self, m: SimMachine, force: bool = False) -> bool:
+        """Shared-fate blackout of one machine: every resident process
+        goes dark AT ONE INSTANT (no awaits between component stops).
+        Returns False (and does nothing) for protected machines or kills
+        the replication mode could not survive."""
+        if m.protected:
+            self.protected_kill_attempts += 1
+            TraceEvent("SimKillRefusedProtected").detail(
+                "Machine", m.name
+            ).log()
+            return False
+        if not m.alive:
+            return False
+        if not force and not self.can_kill([m]):
+            TraceEvent("SimKillRefusedQuorum").detail("Machine", m.name).log()
+            return False
+        self._blackout(m)
+        return True
+
+    def _blackout(self, m: SimMachine) -> None:
+        m.alive = False
+        m.kills += 1
+        self.net.blackout(m.proc)
+        for t in m.storage_tags:
+            self.cluster.storages[t].stop()
+        if m.has_txn or m.log_ids:
+            # Co-resident transaction-system roles die with the machine —
+            # the shared-fate instant per-role kills could never produce.
+            # (A resident tlog keeps its state — kill == blackout — but
+            # its loss of service takes the generation down; recovery
+            # fences and continues, the reference's machine-reboot path.)
+            self.cluster.kill_transaction_system()
+        TraceEvent("SimMachineKilled", severity=30).detail(
+            "Machine", m.name
+        ).detail("DC", m.dc.name).detail(
+            "Storages", len(m.storage_tags)
+        ).detail("Logs", len(m.log_ids)).detail(
+            "Txn", m.has_txn
+        ).log()
+
+    def restore_machine(self, m: SimMachine) -> None:
+        if m.alive:
+            return
+        m.alive = True
+        self.net.restore(m.proc)
+        for t in m.storage_tags:
+            self.cluster.storages[t].start()
+        TraceEvent("SimMachineRestored").detail("Machine", m.name).log()
+
+    async def reboot_machine(self, m: SimMachine, outage: float = 0.2,
+                             power_loss: bool = False) -> bool:
+        """Restart one machine. Clean reboot preserves all state (sim2's
+        RebootProcess); power-loss reboot first resolves the machine's
+        un-fsynced disk pages by seeded coin flip and rebuilds its tlog
+        and storage engines from whatever survived, then runs a full
+        recovery — the in-run equivalent of the kill -9 + cold boot the
+        restart specs exercise across incarnations."""
+        if not self.kill_machine(m):
+            return False
+        loop = current_loop()
+        await loop.delay(outage)
+        if power_loss and self.disk is not None:
+            self._power_loss(m)
+        self.restore_machine(m)
+        return True
+
+    def _power_loss(self, m: SimMachine) -> None:
+        cluster = self.cluster
+        datadir = cluster.datadir
+        prefixes = [f"{datadir}/storage{t}" for t in m.storage_tags]
+        prefixes += [f"{datadir}/log{i}" for i in m.log_ids]
+        stats = self.disk.kill(prefixes=prefixes)
+        TraceEvent("SimPowerLoss", severity=30).detail(
+            "Machine", m.name
+        ).detail("Dropped", stats["dropped"]).detail(
+            "Corrupted", stats["corrupted"]
+        ).detail("Kept", stats["kept"]).log()
+
+        from ..cluster.durable_tlog import DurableTaggedTLog
+        from ..cluster.sharded_cluster import _make_engine
+        from ..cluster.storage import StorageServer
+
+        for i in m.log_ids:
+            old = cluster.log_system.logs[i]
+            # stop (not close): close would flush through fds the disk
+            # kill already invalidated; the dead incarnation just drops.
+            old.stop()
+            cluster.log_system.logs[i] = DurableTaggedTLog(
+                f"{datadir}/log{i}", os_layer=self.disk
+            )
+        for t in m.storage_tags:
+            old = cluster.storages[t]  # already stopped by the kill
+            engine = _make_engine(self.engine_kind,
+                                  f"{datadir}/storage{t}",
+                                  os_layer=self.disk)
+            fresh = StorageServer(cluster.log_system.tag_view(t), 0,
+                                  tag=t, engine=engine)
+            # Clients keep their endpoint: the rebooted server serves the
+            # same stream (the reference's interface tokens survive role
+            # restarts the same way).
+            fresh.read_stream = old.read_stream
+            # Shard assignment is cluster metadata, not machine state —
+            # carried over as a stand-in for the reference's re-derivation
+            # from the recovered txnStateStore.
+            fresh.owned = old.owned
+            fresh.assigned = old.assigned
+            cluster.storages[t] = fresh
+        # The rebuilt tlog's durable top is wherever its last fsync
+        # reached: fence + truncate the quorum to the new minimum before
+        # anything trusts the old frontier (a cold boot IS a recovery).
+        cluster._recover()
+
+    def kill_datacenter(self, dc: SimDatacenter) -> list[SimMachine]:
+        """Blackout every non-protected machine of one DC at one instant
+        (ref: killDataCenter, sim2.actor.cpp:1417). Returns the machines
+        actually killed ([] when the quorum-safety gate refuses)."""
+        victims = [m for m in dc.machines if m.alive and not m.protected]
+        if not victims or not self.can_kill(victims):
+            TraceEvent("SimDcKillRefused").detail("DC", dc.name).log()
+            return []
+        for m in victims:
+            self._blackout(m)
+        TraceEvent("SimDcKilled", severity=30).detail("DC", dc.name).detail(
+            "Machines", len(victims)
+        ).log()
+        return victims
+
+    # -- network faults at machine/DC granularity --
+    def clog_machine_pair(self, a: SimMachine, b: SimMachine,
+                          seconds: float) -> None:
+        self.net.clog_pair_sets([a.proc], [b.proc], seconds)
+
+    def clog_dc_pair(self, a: SimDatacenter, b: SimDatacenter,
+                     seconds: float) -> None:
+        self.net.clog_pair_sets(
+            [m.proc for m in a.machines], [m.proc for m in b.machines],
+            seconds,
+        )
+
+    async def swizzle(self, random, max_clog: float = 1.0) -> None:
+        """Swizzled clogging over the machines (sim2's swizzled clog):
+        clog a random machine subset's links, unclog in random order."""
+        await self.net.swizzle_clog(
+            [[m.proc] for m in self.machines], random, max_clog
+        )

@@ -297,6 +297,33 @@ async def _run_workloads(cluster, db, spec) -> dict[str, Any]:
             checkers.append((rkey, None, lambda w=w: {
                 "reboots": w.get("reboots", 2)
             }))
+        elif name == "MachineAttrition":
+            # Machine/DC shared-fate kills + swizzled clogs off the
+            # topology (sim/topology.py; ref: MachineAttrition.actor.cpp
+            # at machine granularity). Needs the cluster spec to carry a
+            # "topology" stanza so the roles are placed on machines.
+            from .attrition import MachineAttritionWorkload
+
+            topo = getattr(cluster, "sim_topology", None)
+            if topo is None:
+                raise SpecError(
+                    "MachineAttrition needs cluster.topology (e.g. "
+                    '"topology": {"n_dcs": 3, "machines_per_dc": 2}) on a '
+                    "recoverable_sharded cluster"
+                )
+            wl = MachineAttritionWorkload(
+                topo,
+                interval=w.get("interval", 0.8),
+                kills=w.get("kills", 2),
+                reboots=w.get("reboots", 1),
+                swizzles=w.get("swizzles", 1),
+                dc_kills=w.get("dc_kills", 0),
+                outage=w.get("outage", 0.4),
+                power_loss=w.get("power_loss", False),
+                name=f"machine-attrition-{rkey}",
+            ).start()
+            starters.append((rkey, wl.done))
+            checkers.append((rkey, wl.check, wl.metrics))
         elif name == "DataDistribution":
             dd = cluster.start_data_distribution(
                 interval=w.get("interval", 0.2)
@@ -336,8 +363,33 @@ async def _run_workloads(cluster, db, spec) -> dict[str, Any]:
         results["ConsistencyCheck"] = {"ok": bool(await cc.check()),
                                        "failures": cc.failures}
         ok = ok and results["ConsistencyCheck"]["ok"]
+        # Final keyspace fingerprint: same seed ⇒ same kill schedule ⇒
+        # same final state — the chaos specs' reproducibility contract
+        # is checked by comparing this across reruns.
+        results["fingerprint"] = await _keyspace_fingerprint(cluster)
     results["ok"] = ok
     return results
+
+
+async def _keyspace_fingerprint(cluster) -> str:
+    """Injective digest of the settled keyspace, read shard-by-shard from
+    each team's first replica (the closing ConsistencyCheck has already
+    proven the replicas identical)."""
+    import hashlib
+
+    from ..kv.keys import KEYSPACE_END
+
+    target = max(s.version.get() for s in cluster.storages)
+    for s in cluster.storages:
+        await s.version.when_at_least(target)
+    h = hashlib.sha256()
+    for b, e, team in cluster.shard_map.ranges():
+        if not team:
+            continue
+        e = e if e is not None else KEYSPACE_END
+        for k, v in cluster.storages[team[0]].data.get_range(b, e, target):
+            h.update(b"%d:%b=%d:%b;" % (len(k), k, len(v), v))
+    return h.hexdigest()
 
 
 def _apply_knobs(overrides: dict):
@@ -485,13 +537,23 @@ def run_spec(spec: dict) -> dict[str, Any]:
                 from ..cluster.recovery import RecoverableShardedCluster
 
                 cluster = RecoverableShardedCluster(**ckw).start()
+                if ckw.get("topology") is not None:
+                    # Machine/DC fault topology: role placement over
+                    # SimMachines + a client database whose hops cross
+                    # the simulated network (sim/topology.py).
+                    from ..sim.topology import MachineTopology
+
+                    cluster.sim_topology = MachineTopology(
+                        cluster, **ckw["topology"]
+                    )
             elif ckind == "local":
                 from ..cluster.cluster import LocalCluster
 
                 cluster = LocalCluster(**ckw).start()
             else:
                 raise SpecError(f"unknown cluster kind {ckind!r}")
-            db = cluster.database()
+            topo = getattr(cluster, "sim_topology", None)
+            db = topo.database() if topo is not None else cluster.database()
             try:
                 return await _run_workloads(cluster, db, spec)
             finally:
