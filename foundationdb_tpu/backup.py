@@ -373,7 +373,11 @@ class ContinuousBackupAgent:
                 self.ship_error = f"{type(e).__name__}: {e}"
                 TraceEvent("BackupShipError",
                            severity=30).error(e).log()
-                await current_loop().delay(0.5)
+                from .core.knobs import SERVER_KNOBS
+
+                await current_loop().delay(
+                    SERVER_KNOBS.BACKUP_SHIP_RETRY_INTERVAL
+                )
 
     async def wait_until(self, version: int) -> None:
         from .core.runtime import current_loop

@@ -35,7 +35,7 @@ from ..core.errors import OperationFailed, TLogStopped
 from ..core.knobs import SERVER_KNOBS
 from ..core.runtime import TaskPriority, current_loop, spawn
 from ..core.trace import TraceEvent
-from ..resolver.cpu import ConflictSetCPU
+from ..resolver.factory import make_conflict_set
 from .coordination import CoordinatedState, CoordinatorRegister, LeaderElection
 from .master import Master
 from .proxy import CommitProxy
@@ -127,7 +127,7 @@ class RecoverableCluster:
         n_coordinators: int = 3,
     ):
         self.conflict_set_factory = conflict_set_factory or (
-            lambda v: ConflictSetCPU(v)
+            lambda v: make_conflict_set(v)
         )
         self.coordinators = [
             CoordinatorRegister(f"coord{i}") for i in range(n_coordinators)
@@ -341,11 +341,10 @@ class RecoverableShardedCluster:
 
     def __init__(self, conflict_set_factory=None, n_coordinators: int = 3,
                  **sharded_kw):
-        from ..resolver.cpu import ConflictSetCPU
         from .sharded_cluster import ShardedKVCluster
 
         self.conflict_set_factory = conflict_set_factory or (
-            lambda v: ConflictSetCPU(v)
+            lambda v: make_conflict_set(v)
         )
         self.inner = ShardedKVCluster(**sharded_kw)
         datadir = sharded_kw.get("datadir")

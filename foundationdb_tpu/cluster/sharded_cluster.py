@@ -25,7 +25,7 @@ from typing import Optional, Sequence
 
 from ..core.rand import DeterministicRandom
 from ..kv.keys import KEYSPACE_END, KeyRange
-from ..resolver.cpu import ConflictSetCPU
+from ..resolver.factory import make_conflict_set
 from .log_system import TagPartitionedLogSystem
 from .master import Master
 from .proxy import CommitProxy
@@ -128,13 +128,13 @@ class ShardedKVCluster:
             ])
             self.resolver_config = ResolverConfig(bounds)
             self.resolvers = [
-                ResolverRole(ConflictSetCPU(0), 0)
+                ResolverRole(make_conflict_set(0), 0)
                 for _ in range(n_resolvers)
             ]
         else:
             self.resolvers = [ResolverRole(
                 conflict_set if conflict_set is not None
-                else ConflictSetCPU(0),
+                else make_conflict_set(0),
                 0,
             )]
         self.resolver = self.resolvers[0]

@@ -23,6 +23,7 @@ INetwork seam). Design decisions, TPU-first rationale:
 from __future__ import annotations
 
 import heapq
+import inspect
 import time as _time
 from typing import Any, Awaitable, Callable, Coroutine, Optional, TypeVar
 
@@ -195,6 +196,18 @@ class Task:
         self._resume_cb = None
         self._cancelled = False
 
+    def __del__(self):
+        # A task dropped (with its loop) before its FIRST step still holds
+        # an un-started coroutine; close it so GC doesn't emit the "never
+        # awaited" RuntimeWarning (promoted to an error in pytest.ini).
+        # Started-then-suspended coroutines are closed by GC natively.
+        try:
+            coro = self.coro
+            if inspect.getcoroutinestate(coro) == inspect.CORO_CREATED:
+                coro.close()
+        except Exception:  # pragma: no cover - interpreter teardown
+            pass
+
     def cancel(self) -> None:
         """Cancel the actor (ref: actor_cancelled on future drop)."""
         if self.done.is_ready() or self._cancelled:
@@ -207,8 +220,21 @@ class Task:
             self._waiting_on = None
             self._resume_cb = None
             loop._schedule_step(self, None, ActorCancelled())
-        # If currently on the ready queue, the pending step will observe
-        # _cancelled and throw into the coroutine.
+        elif inspect.getcoroutinestate(self.coro) == inspect.CORO_CREATED:
+            # Spawned but never stepped. Nothing guarantees the loop runs
+            # again (a test's main() stops the cluster and returns;
+            # run_until exits the moment main resolves), so the queued
+            # first step may never execute and the un-started coroutine
+            # would be GC'd with a "never awaited" RuntimeWarning (VERDICT
+            # r5 weak #6 — promoted to an error in pytest.ini). Throwing
+            # into a never-started coroutine executes no user code anyway:
+            # close it now and resolve done; the pending ready-queue step
+            # observes the ready future and no-ops.
+            self.coro.close()
+            self.done._send_error(ActorCancelled())
+        # Otherwise: currently on the ready queue mid-execution; the
+        # pending step will observe _cancelled and throw into the
+        # coroutine.
 
 
 class Clock:

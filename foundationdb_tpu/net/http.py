@@ -166,9 +166,14 @@ class _Exchange:
 
 async def http_request(host: str, port: int, method: str, path: str,
                        headers: Optional[dict] = None, body: bytes = b"",
-                       timeout: float = 30.0) -> HTTPResponse:
+                       timeout: float | None = None) -> HTTPResponse:
     """One HTTP exchange; resolves with the full response or raises
-    ConnectionFailed/TimedOut."""
+    ConnectionFailed/TimedOut. The default deadline is
+    CLIENT_KNOBS.HTTP_REQUEST_TIMEOUT (randomized under sim)."""
+    if timeout is None:
+        from ..core.knobs import CLIENT_KNOBS
+
+        timeout = CLIENT_KNOBS.HTTP_REQUEST_TIMEOUT
     loop = current_loop()
     reactor = getattr(loop, "reactor", None)
     if reactor is None:
@@ -199,7 +204,7 @@ async def http_request(host: str, port: int, method: str, path: str,
 
 def http_request_sync(host: str, port: int, method: str, path: str,
                       headers: Optional[dict] = None, body: bytes = b"",
-                      timeout: float = 30.0) -> HTTPResponse:
+                      timeout: float | None = None) -> HTTPResponse:
     """Synchronous form: drives its OWN private reactor to completion.
     The outer loop's timers simply wait — container ops are short and the
     caller is blocked on them anyway (long-running shipping should use
@@ -208,6 +213,10 @@ def http_request_sync(host: str, port: int, method: str, path: str,
 
     from .reactor import SelectReactor
 
+    if timeout is None:
+        from ..core.knobs import CLIENT_KNOBS
+
+        timeout = CLIENT_KNOBS.HTTP_REQUEST_TIMEOUT
     reactor = SelectReactor()
     result: list = []
     ex = _Exchange(reactor, host, port, method, path, headers, body,

@@ -6,9 +6,14 @@ provided by two interchangeable backends:
 
 - `ConflictSetCPU` (cpu.py): an exact step-function reference, the oracle for
   differential testing.
-- `ConflictSetTPU` (tpu.py): the batched JAX kernel — sorted interval tensors,
-  rank merging, sparse-table range-max and a segment-tree min-index fixed
-  point, all under jit, sized for 64K-1M transaction batches.
+- `ConflictSetTPU` (tpu.py): the batched JAX kernel — block-sparse resident
+  history behind a fence directory, touched-block superset merges, amortized
+  device compaction — sized for 64K-1M transaction batches.
+- `ConflictSetNativeCPU` (native_cpu.py): the C++ detector, SkipList-class
+  throughput on one core; the deployed-tier default.
+
+Deployed tiers recruit through `make_conflict_set` (factory.py), driven by
+SERVER_KNOBS.CONFLICT_SET_IMPL.
 """
 
 from .types import (  # noqa: F401
@@ -19,3 +24,4 @@ from .types import (  # noqa: F401
     TxnConflictInfo,
 )
 from .cpu import ConflictSetCPU  # noqa: F401
+from .factory import make_conflict_set  # noqa: F401

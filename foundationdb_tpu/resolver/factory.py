@@ -1,0 +1,60 @@
+"""Conflict-set backend selection for deployed tiers.
+
+The reference has exactly one conflict detector (the C++ SkipList,
+fdbserver/SkipList.cpp) so recruitment just constructs it; this repo has
+three interchangeable, differentially-pinned backends, and every deployed
+tier used to hardcode the slowest one (the pure-Python oracle, ~7K txns/s).
+`make_conflict_set` is the single recruitment point, driven by
+SERVER_KNOBS.CONFLICT_SET_IMPL:
+
+  oracle  pure-Python step function (cpu.py) — the differential reference.
+  native  C++ detector (native/conflict_set.cpp via ctypes) — SkipList-class
+          throughput on one core; the DEFAULT for deployed tiers. Falls back
+          to the oracle, loudly, when the .so is not built (dev containers).
+  tpu     the batched block-sparse JAX kernel (tpu.py) — opt-in: recruiting
+          a device-backed resolver is a deployment decision (chip
+          affinity, warmup), not something a default should spring on a
+          6-process cluster.
+
+Every backend honors the same contract (resolve/entries/oldest_version), so
+recruitment sites stay one-liners and sim seeds replay identically across
+backends (statuses are bit-for-bit by the differential suite).
+"""
+
+from __future__ import annotations
+
+
+def make_conflict_set(init_version: int = 0, impl: str | None = None):
+    """Construct the knob-selected conflict set at `init_version`.
+
+    `impl` overrides SERVER_KNOBS.CONFLICT_SET_IMPL (tests, explicit
+    recruitment). Unknown values raise — a typo'd knob must not silently
+    recruit the slow path.
+    """
+    from ..core.knobs import SERVER_KNOBS
+
+    name = (impl or SERVER_KNOBS.CONFLICT_SET_IMPL).lower()
+    if name == "tpu":
+        from .tpu import ConflictSetTPU
+
+        return ConflictSetTPU(init_version)
+    if name == "native":
+        from .native_cpu import ConflictSetNativeCPU, load
+
+        if load() is not None:
+            return ConflictSetNativeCPU(init_version)
+        # The .so is an optional build artifact; a missing library must
+        # degrade to a correct (if slow) cluster, not a dead one.
+        from ..core.trace import TraceEvent
+
+        TraceEvent("ConflictSetNativeUnavailable", severity=30).detail(
+            "FallingBackTo", "oracle"
+        ).log()
+        name = "oracle"
+    if name == "oracle":
+        from .cpu import ConflictSetCPU
+
+        return ConflictSetCPU(init_version)
+    raise ValueError(
+        f"unknown CONFLICT_SET_IMPL {name!r} (oracle|native|tpu)"
+    )

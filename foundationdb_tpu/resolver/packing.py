@@ -36,6 +36,20 @@ bucketing"; pure pow2 rounding wasted up to 2x per dimension, compounding
 into the endpoint space). Finer buckets mean more first-encounter compiles
 than pow2 (8 per octave per dimension): deployments warm their expected
 batch footprints via ConflictSetTPU.warmup.
+
+Block-sparse state helpers (resolver/tpu.py's r6 layout): the device
+history is NB blocks of B sorted slots with a fence directory (each
+block's minimum live key). `empty_block_state` builds the fresh state;
+`encode_packed_words` renders packed key words as memcmp-ordered byte
+strings — the HOST's mirror of the fence directory, so every dispatch
+ranks the batch's write endpoints into blocks (np.searchsorted), picks
+the touched-block set and proves per-block slot headroom without any
+device round trip. The touched-block count K is a jit shape dimension
+exactly like the row caps, so StickyCaps carries a K dimension
+(k_cap_for/update_k) with the same high-water + epoch-decay policy —
+jittering touched-block counts must not recompile the commit path.
+PackedBatch ships the encoded write endpoints (wb_enc/we_enc) for this
+ranking; they are None-cost for callers that never hit a block-sparse set.
 """
 
 from __future__ import annotations
@@ -154,6 +168,32 @@ class StickyCaps:
             e[i] = max(e[i], v)
             e[D + i] = max(e[D + i], v)
 
+    # -- touched-block cap (block-sparse kernel; see resolver/tpu.py) --
+    # The gathered-block count K is a jit shape dimension exactly like the
+    # row caps: batches whose touched-block counts jitter would otherwise
+    # re-bucket (and recompile) almost every batch. Same high-water +
+    # epoch-decay policy, keyed by the txn bucket.
+
+    def k_cap_for(self, n_txns: int) -> int:
+        t = next_bucket(max(n_txns, 1))
+        e = self._k().get(t)
+        return e[0] if e else 0
+
+    def update_k(self, n_txns: int, k_bucket: int) -> None:
+        t = next_bucket(max(n_txns, 1))
+        e = self._k().setdefault(t, [0, 0, 0])
+        e[0] = max(e[0], k_bucket)
+        e[1] = max(e[1], k_bucket)
+        e[2] += 1
+        if e[2] >= self._decay_batches():
+            e[0], e[1], e[2] = e[1], 0, 0
+
+    def _k(self) -> dict:
+        m = getattr(self, "_mk", None)
+        if m is None:
+            m = self._mk = {}
+        return m
+
 
 _sort_native = None
 _sort_native_tried = False
@@ -232,6 +272,51 @@ def unpack_key(words: np.ndarray, length: int) -> bytes:
     """Inverse of pack_keys for one key (tests/debugging)."""
     u = (words.astype(np.int32).view(np.uint32) ^ BIAS).astype(">u4")
     return u.tobytes()[:length]
+
+
+def encode_packed_words(words: np.ndarray, lens: np.ndarray) -> np.ndarray:
+    """Encode packed (N, n_words) biased-int32 words + lengths as fixed-width
+    byte strings whose memcmp order equals the (words..., len) tuple order —
+    the same encoding ConflictSetRankFed mirrors keys in. Used for the HOST
+    mirror of the block-sparse conflict set's fence directory: np.searchsorted
+    over the encoded fences ranks batch endpoints into blocks without any
+    device round trip."""
+    w = np.ascontiguousarray(words, dtype=np.int32)
+    n, n_words = w.shape
+    raw = (
+        (w.view(np.uint32) ^ np.uint32(0x80000000))
+        .astype(">u4").view(np.uint8).reshape(n, 4 * n_words)
+    )
+    lens_b = np.asarray(lens, dtype=np.int32).astype(">u4").view(
+        np.uint8).reshape(n, 4)
+    buf = np.concatenate([raw, lens_b], axis=1)
+    return np.ascontiguousarray(buf).view(f"S{4 * (n_words + 1)}").reshape(-1)
+
+
+def empty_block_state(n_words: int, NB: int, B: int, init_version: int):
+    """Fresh block-sparse state: (hmat (n_words+2, NB*B), counts (NB,),
+    fences (n_words+1, NB), btree (2*NB,)). Block 0 holds the empty-key
+    sentinel at init_version (the skip-list header analogue); every other
+    slot is pad. Fences of unused blocks are +inf so the device fence probe
+    ranks every real key into the live prefix."""
+    hmat = state_pad_block(n_words, NB * B)
+    w0, l0 = pack_keys([b""], n_words)
+    hmat[:n_words, 0] = w0[0]
+    hmat[n_words, 0] = l0[0]
+    hmat[n_words + 1, 0] = init_version
+    counts = np.zeros(NB, dtype=np.int32)
+    counts[0] = 1
+    fences = np.zeros((n_words + 1, NB), dtype=np.int32)
+    fences[:n_words, :] = PAD_WORD
+    fences[n_words, :] = INT32_MAX
+    fences[:n_words, 0] = w0[0]
+    fences[n_words, 0] = l0[0]
+    btree = np.zeros(2 * NB, dtype=np.int32)
+    node = NB
+    while node >= 1:
+        btree[node] = init_version
+        node //= 2
+    return hmat, counts, fences, btree
 
 
 def state_pad_block(n_words: int, columns: int) -> np.ndarray:
@@ -431,6 +516,13 @@ class PackedBatch:
     n_writes: int
     n_expl_r: int = 0  # rows whose end key ships explicitly
     n_expl_w: int = 0
+    # Host-side encoded write endpoint keys (encode_packed_words order ==
+    # device key order), one per write row: the block-sparse conflict set
+    # ranks them against its fence mirror to pick the touched-block set
+    # without a device round trip. None for callers that never dispatch to
+    # a block-sparse set.
+    wb_enc: np.ndarray | None = None
+    we_enc: np.ndarray | None = None
 
     def set_scalars(self, version_off: int, oldest_off: int) -> None:
         self.buf[self.layout.off_scalars] = version_off
@@ -625,4 +717,6 @@ def pack_batch(
     return PackedBatch(
         n_txns=n_txns, layout=lay, buf=buf, base=oldest_version,
         n_reads=nr, n_writes=nw, n_expl_r=n_er, n_expl_w=n_ew,
+        wb_enc=encode_packed_words(wb_w, wb_l),
+        we_enc=encode_packed_words(we_w, we_l),
     )

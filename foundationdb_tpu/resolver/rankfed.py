@@ -364,6 +364,7 @@ class ConflictSetRankFed:
             raise ValueError("init_version must fit the initial int32 window")
         self.mirror = encode_keys([b""], self.n_words)
         self.n = 1
+        self._since_gc = 0
         hv = np.zeros(self.capacity, dtype=np.int32)
         hv[0] = init_version
         self.hv = jnp.asarray(hv)
@@ -576,7 +577,14 @@ class ConflictSetRankFed:
         if longest > self.max_key_bytes:
             self._grow_width(longest)
         # Capacity: superset inserts burn 2 entries per write row; GC when
-        # the pessimistic bound approaches capacity.
+        # the pessimistic bound approaches capacity, and on the same
+        # amortized cadence as the block-sparse kernel's compaction pass
+        # (SERVER_KNOBS.TPU_COMPACT_EVERY_BATCHES) so a steady write load
+        # re-canonicalizes long before capacity pressure forces it — the
+        # superset's history-scaled device passes otherwise pay for
+        # duplicates the whole window long.
+        from ..core.knobs import SERVER_KNOBS
+
         n_writes = sum(
             1
             for t in txns
@@ -584,8 +592,11 @@ class ConflictSetRankFed:
             for w in t.write_ranges
             if not w.is_empty()
         )
-        if self.n + 2 * n_writes >= self.capacity - 1:
+        self._since_gc += 1
+        if (self.n + 2 * n_writes >= self.capacity - 1
+                or self._since_gc >= SERVER_KNOBS.TPU_COMPACT_EVERY_BATCHES):
             self.gc_round()
+            self._since_gc = 0
             if self.n + 2 * n_writes >= self.capacity - 1:
                 self._grow(self.n + 2 * n_writes + 2)
         pb = self.pack(txns)

@@ -24,6 +24,15 @@ packing time, exactly where the reference's proxy does it.
 Per-txn status combine is max over shards: COMMITTED=0 < CONFLICT=1 <
 TOO_OLD=2, so any-conflict aborts and any-too-old dominates, matching the
 proxy merge order.
+
+Kernel note (r6): the single-chip ConflictSetTPU moved to the
+block-sparse batch-scaled layout; this mesh path still shard_maps the
+DENSE kernel (`tpu._resolve_kernel_impl` — full-history merge per batch,
+now also the block path's compaction engine) over per-shard state. The
+per-shard host work (clip + flatten + common sticky caps) is the exact
+seam the block layout slots into — per-shard fence/fill mirrors and a
+common touched-block bucket across shards; tracked in ROADMAP.md
+("mesh-sharded resolver still dense").
 """
 
 from __future__ import annotations

@@ -39,6 +39,15 @@ _KNOB_RANGES = [
     ("RATEKEEPER_UPDATE_INTERVAL", "server", (0.05, 0.5)),
     ("DEFAULT_BACKOFF", "client", (0.005, 0.1)),
     ("TPU_STICKY_DECAY_BATCHES", "server", (4, 128)),
+    # Hoisted in r6 (VERDICT r5 weak #7 — poll/batch windows the repo
+    # grew in r4/r5 but never perturbed): long-poll peeks, spill reads,
+    # backup ship retries, HTTP deadlines, and the block-sparse conflict
+    # set's compaction cadence.
+    ("TLOG_PEEK_LONG_POLL_WINDOW", "server", (0.5, 10.0)),
+    ("TLOG_SPILL_PEEK_BATCH", "server", (4, 1024)),
+    ("BACKUP_SHIP_RETRY_INTERVAL", "server", (0.05, 1.0)),
+    ("HTTP_REQUEST_TIMEOUT", "client", (5.0, 60.0)),
+    ("TPU_COMPACT_EVERY_BATCHES", "server", (2, 32)),
 ]
 
 _REPLICATION_FOR = {3: ["single", "double", "triple"],

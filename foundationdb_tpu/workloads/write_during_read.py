@@ -96,9 +96,17 @@ class WriteDuringReadWorkload:
 
     async def run(self, txns: int = 30, ops_per_txn: int = 12) -> None:
         rng = current_loop().random
-        for _ in range(txns):
+        for i in range(txns):
             tr = self.db.create_transaction()
             mt = ModelTransaction(self.model)
+            # Unique marker OUTSIDE the checked prefix: transactions are
+            # atomic, so after a maybe-committed failure (commit reply lost
+            # to a recovery/kill) the marker's presence decides exactly
+            # whether the model txn landed. Guessing "not committed" here
+            # diverged the model under MachineAttrition (a committed txn's
+            # keys kept showing up in later range reads).
+            marker = self.prefix[:-1] + b"m/%06d" % i
+            tr.set(marker, b"1")
             try:
                 for _ in range(ops_per_txn):
                     await self._one_op(tr, mt, rng)
@@ -106,9 +114,14 @@ class WriteDuringReadWorkload:
             except BaseException as e:  # noqa: BLE001
                 from ..core.errors import is_retryable
 
-                if is_retryable(e):
-                    continue  # txn dropped from BOTH sides: still in sync
-                raise
+                if not is_retryable(e):
+                    raise
+                landed = await self.db.transact(
+                    lambda t, k=marker: t.get(k)
+                )
+                if landed is None:
+                    continue  # really dropped from BOTH sides
+                # The "failed" commit actually landed: apply the model txn.
             mt.commit_into(self.model)
             self.txns_done += 1
         # Final sweep: committed cluster state equals the model.

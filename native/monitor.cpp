@@ -127,9 +127,13 @@ pid_t spawn(const std::string& command) {
   _exit(127);
 }
 
-time_t mtime_of(const std::string& path) {
+// Nanosecond mtime: st_mtime alone is 1s-granular, so a conf rewritten
+// within the same wall-clock second as the previous write (common in tests
+// and scripted rollouts) would never be seen as changed.
+int64_t mtime_of(const std::string& path) {
   struct stat st;
-  return stat(path.c_str(), &st) == 0 ? st.st_mtime : 0;
+  if (stat(path.c_str(), &st) != 0) return 0;
+  return (int64_t)st.st_mtim.tv_sec * 1000000000 + st.st_mtim.tv_nsec;
 }
 
 }  // namespace
@@ -155,7 +159,7 @@ int main(int argc, char** argv) {
   double poll = general.count("conf_poll_seconds")
                     ? atof(general["conf_poll_seconds"].c_str())
                     : 1.0;
-  time_t conf_mtime = mtime_of(conf_path);
+  int64_t conf_mtime = mtime_of(conf_path);
 
   std::map<std::string, Child> children;
   auto start = [&](const std::string& name, const std::string& cmd) {
@@ -197,7 +201,7 @@ int main(int argc, char** argv) {
     }
     // Conf reload on mtime change (ref: fdbmonitor's inotify watch :638;
     // polling keeps this portable).
-    time_t mt = mtime_of(conf_path);
+    int64_t mt = mtime_of(conf_path);
     if (mt != conf_mtime) {
       conf_mtime = mt;
       std::map<std::string, std::string> g2;

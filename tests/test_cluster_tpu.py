@@ -72,3 +72,27 @@ def test_cycle_on_sharded_mesh_resolver():
         ok, retries = loop.run(main(), timeout_sim_seconds=1e6)
     assert ok
     assert retries > 0  # cross-shard conflicts detected and retried
+
+
+def test_cycle_attrition_on_knob_selected_tpu_resolver():
+    """The TPU conflict set recruited purely by SERVER_KNOBS.CONFLICT_SET_IMPL
+    (resolver/factory.py), exercised by the recovery-capable sharded cluster
+    under the Cycle invariant with the Attrition nemesis killing transaction
+    roles — every recovery re-recruits a FRESH device conflict set through
+    the factory and the invariant must hold across generations."""
+    from foundationdb_tpu.workloads.tester import run_spec
+
+    spec = {
+        "seed": 1711,
+        "buggify": True,
+        "knobs": {"server:CONFLICT_SET_IMPL": "tpu"},
+        "cluster": {"kind": "recoverable_sharded", "n_storage": 3,
+                    "n_logs": 1, "replication": "single"},
+        "workloads": [
+            {"name": "Cycle", "nodes": 10, "clients": 2, "txns": 10},
+            {"name": "Attrition", "interval": 0.8, "kills": 2},
+        ],
+    }
+    res = run_spec(spec)
+    assert res.get("ok"), res
+    assert not res.get("sev_errors"), res
