@@ -94,6 +94,13 @@ class ServerKnobs(Knobs):
         # state + more superset slack per block.
         init("TPU_BLOCK_SLOTS", 32)
         init("TPU_COMPACT_EVERY_BATCHES", 16, sim_random_range=(2, 32))
+        # Cap on the touched-block gather bucket K (single-chip and
+        # mesh-sharded fast paths): a batch whose write endpoints spray
+        # more blocks than this falls back to the compaction (dense) pass
+        # instead of compiling an outsized gather shape. The default never
+        # binds a sane deployment; simulation randomizes it low to exercise
+        # the fallback.
+        init("TPU_MAX_TOUCHED_BLOCKS", 1 << 17, sim_random_range=(8, 64))
         # Storage (ref: fdbserver/Knobs.cpp storage section)
         init("STORAGE_DURABILITY_LAG_VERSIONS", 5 * 1_000_000)
         init("STORAGE_COMMIT_INTERVAL", 0.5)

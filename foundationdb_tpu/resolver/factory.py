@@ -24,12 +24,18 @@ backends (statuses are bit-for-bit by the differential suite).
 from __future__ import annotations
 
 
-def make_conflict_set(init_version: int = 0, impl: str | None = None):
+def make_conflict_set(init_version: int = 0, impl: str | None = None, **kw):
     """Construct the knob-selected conflict set at `init_version`.
 
     `impl` overrides SERVER_KNOBS.CONFLICT_SET_IMPL (tests, explicit
     recruitment). Unknown values raise — a typo'd knob must not silently
-    recruit the slow path.
+    recruit the slow path. Extra keyword arguments pass through to the
+    selected backend's constructor (capacity/key-width sizing at explicit
+    recruitment sites); the tpu backend additionally reads its block/
+    compaction/touched-block knobs (TPU_BLOCK_SLOTS,
+    TPU_COMPACT_EVERY_BATCHES, TPU_MAX_TOUCHED_BLOCKS) from SERVER_KNOBS
+    at construction/dispatch time, so sim knob randomization reaches it
+    with no plumbing here.
     """
     from ..core.knobs import SERVER_KNOBS
 
@@ -37,7 +43,7 @@ def make_conflict_set(init_version: int = 0, impl: str | None = None):
     if name == "tpu":
         from .tpu import ConflictSetTPU
 
-        return ConflictSetTPU(init_version)
+        return ConflictSetTPU(init_version, **kw)
     if name == "native":
         from .native_cpu import ConflictSetNativeCPU, load
 

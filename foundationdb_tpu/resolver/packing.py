@@ -172,16 +172,20 @@ class StickyCaps:
     # The gathered-block count K is a jit shape dimension exactly like the
     # row caps: batches whose touched-block counts jitter would otherwise
     # re-bucket (and recompile) almost every batch. Same high-water +
-    # epoch-decay policy, keyed by the txn bucket.
+    # epoch-decay policy, keyed by (txn bucket, shard count): the mesh-
+    # sharded resolver shares ONE K across all shards (the stacked gather
+    # tensors must shard evenly), so its per-shard maxima ratchet a
+    # separate cap from any single-chip set sharing this StickyCaps —
+    # n_shards is that extra key dimension.
 
-    def k_cap_for(self, n_txns: int) -> int:
+    def k_cap_for(self, n_txns: int, n_shards: int = 1) -> int:
         t = next_bucket(max(n_txns, 1))
-        e = self._k().get(t)
+        e = self._k().get((t, n_shards))
         return e[0] if e else 0
 
-    def update_k(self, n_txns: int, k_bucket: int) -> None:
+    def update_k(self, n_txns: int, k_bucket: int, n_shards: int = 1) -> None:
         t = next_bucket(max(n_txns, 1))
-        e = self._k().setdefault(t, [0, 0, 0])
+        e = self._k().setdefault((t, n_shards), [0, 0, 0])
         e[0] = max(e[0], k_bucket)
         e[1] = max(e[1], k_bucket)
         e[2] += 1

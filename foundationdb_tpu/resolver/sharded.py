@@ -25,14 +25,26 @@ Per-txn status combine is max over shards: COMMITTED=0 < CONFLICT=1 <
 TOO_OLD=2, so any-conflict aborts and any-too-old dominates, matching the
 proxy merge order.
 
-Kernel note (r6): the single-chip ConflictSetTPU moved to the
-block-sparse batch-scaled layout; this mesh path still shard_maps the
-DENSE kernel (`tpu._resolve_kernel_impl` — full-history merge per batch,
-now also the block path's compaction engine) over per-shard state. The
-per-shard host work (clip + flatten + common sticky caps) is the exact
-seam the block layout slots into — per-shard fence/fill mirrors and a
-common touched-block bucket across shards; tracked in ROADMAP.md
-("mesh-sharded resolver still dense").
+Kernel note (r7): the mesh path now runs the same BLOCK-SPARSE
+batch-scaled layout as the single-chip ConflictSetTPU (r6). Every shard
+holds NB fixed-size blocks behind its own fence directory + block-max
+segment tree, stacked on the mesh axis: hmat (S, W+2, NB*B), counts
+(S, NB), fences (S, W+1, NB), btree (S, 2*NB), n (S,). The host keeps a
+PER-SHARD fence/fill mirror (encode_packed_words byte strings + a
+pessimistic fill bound) and ranks each shard's clipped write endpoints
+into its own blocks — the same `tpu._touched_blocks` the single-chip
+dispatch uses, run once per shard. One COMMON touched-block bucket K
+(the max over shards, StickyCaps-pinned per (txn bucket, shard count))
+keeps the stacked gather tensors sharding evenly, so jit shapes stay
+pinned while per-shard touched counts jitter. The fast step shard_maps
+`tpu._resolve_block_kernel_impl` per device; the amortized compaction
+(every SERVER_KNOBS.TPU_COMPACT_EVERY_BATCHES, or early when any shard's
+fill bound can't prove headroom) shard_maps `tpu._compact_resolve_impl`
+— all shards densify, run the DENSE kernel (clamp + coalesce + rebase)
+and redistribute at fill B//2 together, so the block count NB stays
+common across the mesh. The dense kernel is therefore no longer any
+shard's per-batch path: device work scales with the batch on every
+deployed resolver tier.
 """
 
 from __future__ import annotations
@@ -43,7 +55,15 @@ import numpy as np
 
 from ..kv.keys import KeyRange
 from .cpu import ConflictSetCPU
-from .packing import KeyWidthError, flatten_batch, next_pow2, pack_batch
+from .packing import (
+    INT32_MAX,
+    PAD_WORD,
+    KeyWidthError,
+    flatten_batch,
+    next_bucket,
+    next_pow2,
+    pack_batch,
+)
 from .types import ConflictBatchResult, TxnConflictInfo
 
 
@@ -111,13 +131,21 @@ class ShardedConflictSetCPU:
             statuses = np.maximum(statuses, np.asarray(st))
         return ConflictBatchResult([int(s) for s in statuses])
 
+    def shard_entries(self) -> list[list[tuple[bytes, int]]]:
+        """Per-shard step functions — the differential target for the TPU
+        path's shard_entries()."""
+        return [cs.entries() for cs in self.shards]
+
 
 class ShardedConflictSetTPU:
-    """Device-mesh multi-resolver conflict set.
+    """Device-mesh multi-resolver conflict set, BLOCK-SPARSE per shard.
 
-    State is (S, ...) stacked single-resolver state, sharded over the mesh's
-    `resolvers` axis; resolve() clips + packs per shard on host (common
-    padded shapes so the stack shards evenly), then runs one shard_map step.
+    State is (S, ...) stacked single-resolver block state, sharded over the
+    mesh's `resolvers` axis; resolve() clips + packs per shard on host
+    (common padded shapes so the stack shards evenly), ranks each shard's
+    write endpoints against that shard's host fence mirror, then runs ONE
+    shard_map step — the touched-block fast kernel between compactions,
+    the densify+dense+redistribute compaction on the amortized cadence.
 
     Construction requires a 1-D `jax.sharding.Mesh` whose size equals the
     shard count. On a single chip pass a 1-device mesh (degenerate but
@@ -131,8 +159,18 @@ class ShardedConflictSetTPU:
         init_version: int = 0,
         max_key_bytes: int = 32,
         initial_capacity: int = 1024,
+        min_capacity: int = 64,
+        block_slots: int | None = None,
     ):
         import jax
+
+        from ..core.knobs import SERVER_KNOBS
+        from .packing import (
+            StickyCaps,
+            empty_block_state,
+            encode_packed_words,
+            pack_keys,
+        )
 
         self.boundaries = list(boundaries)
         self.n_shards = len(self.boundaries) + 1
@@ -145,74 +183,141 @@ class ShardedConflictSetTPU:
         self.axis = mesh.axis_names[0]
         self.n_words = max(1, (max_key_bytes + 3) // 4)
         self.max_key_bytes = 4 * self.n_words
-        self.capacity = next_pow2(initial_capacity, minimum=64)
-        self.oldest_version = 0  # absolute version-offset base, all shards
-        self._steps: dict = {}   # FusedLayout.key() -> jitted shard_map step
-        from .packing import StickyCaps
-
+        self.B = next_pow2(
+            int(block_slots or SERVER_KNOBS.TPU_BLOCK_SLOTS), minimum=8
+        )
+        self.F = self.B // 2
+        self.NB = next_pow2(max(initial_capacity, 1) // self.B, minimum=8)
+        self.min_NB = min(
+            next_pow2(max(min_capacity, 1) // self.B, minimum=8), self.NB
+        )
+        if not (0 <= init_version < 2**31):
+            raise ValueError("init_version must fit the initial int32 window")
+        self.oldest_version = 0  # logical GC horizon (absolute), all shards
+        self._base = 0           # device version-offset base (absolute)
+        self._steps: dict = {}   # (kind, layout, shape dims) -> jitted step
         self._sticky = StickyCaps()
-
-        from .packing import empty_state
-
-        S, W, C = self.n_shards, self.n_words, self.capacity
-        # Every shard gets the empty-key sentinel: shard-local histories are
-        # independent step functions over the full key axis; clipping
-        # guarantees only in-shard keys are ever queried or merged.
-        hmat = np.broadcast_to(
-            empty_state(W, C, init_version), (S, W + 2, C)
-        ).copy()
         self._put = lambda x, spec: jax.device_put(
             x, jax.sharding.NamedSharding(self.mesh, spec)
         )
-        self._shard_state(hmat, np.ones(S, dtype=np.int32))
 
-    def _shard_state(self, hmat, n) -> None:
+        S = self.n_shards
+        hmat, counts, fences, btree = empty_block_state(
+            self.n_words, self.NB, self.B, init_version
+        )
+        # Every shard gets the empty-key sentinel: shard-local histories
+        # are independent step functions over the full key axis; clipping
+        # guarantees only in-shard keys are ever queried or merged.
+        self._shard_state(
+            np.broadcast_to(hmat, (S,) + hmat.shape).copy(),
+            np.broadcast_to(counts, (S,) + counts.shape).copy(),
+            np.broadcast_to(fences, (S,) + fences.shape).copy(),
+            np.broadcast_to(btree, (S,) + btree.shape).copy(),
+            np.ones(S, dtype=np.int32),
+        )
+        w0, l0 = pack_keys([b""], self.n_words)
+        enc0 = encode_packed_words(w0, l0)
+        self._fences_enc = [enc0.copy() for _ in range(S)]
+        self._fills = np.zeros((S, self.NB), dtype=np.int64)
+        self._fills[:, 0] = 1
+        self._pending_mirror = None  # (fences_dev, counts_dev) after compact
+        self._since_compact = 0
+        self.last_p2_iters = None
+
+    def _shard_state(self, hmat, counts, fences, btree, n) -> None:
         from jax.sharding import PartitionSpec as P
 
         a = self.axis
         self.hmat = self._put(hmat, P(a, None, None))
+        self.counts = self._put(counts, P(a, None))
+        self.fences = self._put(fences, P(a, None, None))
+        self.btree = self._put(btree, P(a, None))
         self.n = self._put(n, P(a))
+
+    # -- introspection --
+
+    @property
+    def capacity(self) -> int:
+        """Per-shard slot capacity (the stacked state is S x this)."""
+        return self.NB * self.B
+
+    @property
+    def compiled_steps(self) -> int:
+        """Count of distinct compiled shard_map steps (the recompilation
+        guard's probe: jittering batches must not grow this)."""
+        return len(self._steps)
 
     def shard_ranges(self) -> list[tuple[bytes, bytes | None]]:
         return shard_key_ranges(self.boundaries)
 
-    def _build_step(self, lay):
-        import jax
-        import jax.numpy as jnp
-        from jax import lax
-        from jax.experimental.shard_map import shard_map
-        from jax.sharding import PartitionSpec as P
+    def shard_entries(self) -> list[list[tuple[bytes, int]]]:
+        """Per-shard canonicalized step functions (absolute versions) —
+        bit-identical to the sharded CPU oracle's shard_entries() at any
+        point, compactions pending or not."""
+        from .tpu import canonical_entries
 
-        from .tpu import _resolve_kernel_impl
+        hmat = np.asarray(self.hmat)
+        counts = np.asarray(self.counts)
+        return [
+            canonical_entries(hmat[s], counts[s], self.n_words, self.B,
+                              self._base, self.oldest_version)
+            for s in range(self.n_shards)
+        ]
 
-        a = self.axis
+    # -- host mirror --
 
-        def body(hmat, n, fused):
-            hmat_o, n_o, st_aux = _resolve_kernel_impl(
-                hmat[0], n[0], fused[0], lay=lay
+    def _refresh_mirror(self) -> None:
+        """Materialize a compaction's fence/count readback into the host
+        mirrors (ONE small D2H per compaction, paid lazily here)."""
+        if self._pending_mirror is None:
+            return
+        from .packing import encode_packed_words
+
+        fences_dev, counts_dev = self._pending_mirror
+        self._pending_mirror = None
+        counts = np.asarray(counts_dev)   # (S, NB)
+        fw = np.asarray(fences_dev)       # (S, W+1, NB)
+        W = self.n_words
+        self._fences_enc = []
+        for s in range(self.n_shards):
+            nbl = int((counts[s] > 0).sum())
+            self._fences_enc.append(
+                encode_packed_words(fw[s, :W, :nbl].T, fw[s, W, :nbl])
             )
-            # Proxy-side verdict merge as an ICI collective: any shard's
-            # CONFLICT/TOO_OLD wins (MasterProxyServer.actor.cpp:431-447).
-            # The trailing aux bytes: overflow (max ✓) survives the pmax;
-            # the per-shard new_n bytes do not (per-shard counts ride n_o).
-            st_g = lax.pmax(st_aux, a)
-            return hmat_o[None], n_o[None], st_g[None]
+        self._fills = counts.astype(np.int64)
 
-        step = shard_map(
-            body,
-            mesh=self.mesh,
-            in_specs=(P(a, None, None), P(a), P(a, None)),
-            out_specs=(P(a, None, None), P(a), P(a, None)),
-            check_rep=False,
+    # -- growth --
+
+    def _grow_blocks(self, NB_out: int) -> None:
+        from .packing import state_pad_block
+
+        S = self.n_shards
+        pad = (NB_out - self.NB) * self.B
+        hmat = np.asarray(self.hmat)
+        block = np.broadcast_to(
+            state_pad_block(self.n_words, pad), (S, self.n_words + 2, pad)
         )
-        return jax.jit(step)
+        hmat = np.concatenate([hmat, block], axis=2)
+        counts = np.concatenate(
+            [np.asarray(self.counts),
+             np.zeros((S, NB_out - self.NB), dtype=np.int32)], axis=1
+        )
+        if self._fills is not None:
+            self._fills = np.concatenate(
+                [self._fills,
+                 np.zeros((S, NB_out - self.NB), dtype=np.int64)], axis=1
+            )
+        # fences/btree are rebuilt by the compaction this growth precedes.
+        self._shard_state(hmat, counts, np.asarray(self.fences),
+                          np.asarray(self.btree), np.asarray(self.n))
+        self.NB = NB_out
 
     def _grow_width(self, min_key_bytes: int) -> None:
         """Per-shard analogue of ConflictSetTPU._grow_width: widen every
-        shard's packed state (vectorized row insertion), capped by the
-        deployment key-size knob."""
+        shard's packed state AND fence directory in place (vectorized row
+        insertion), capped by the deployment key-size knob."""
         from ..core.knobs import CLIENT_KNOBS
-        from .packing import widen_state
+        from .packing import BIAS, encode_packed_words, widen_state
 
         cap = CLIENT_KNOBS.KEY_SIZE_LIMIT + 1
         if min_key_bytes > cap:
@@ -220,29 +325,112 @@ class ShardedConflictSetTPU:
                 f"key of {min_key_bytes} bytes exceeds the deployment "
                 f"key-size limit {cap}"
             )
+        self._refresh_mirror()
         new_words = min(
             next_pow2((min_key_bytes + 3) // 4, minimum=self.n_words * 2),
             next_pow2((cap + 3) // 4),
         )
+        S, W = self.n_shards, self.n_words
         hmat = np.asarray(self.hmat)
-        widened = np.stack(
-            [widen_state(h, self.n_words, new_words) for h in hmat]
+        widened = np.stack([widen_state(h, W, new_words) for h in hmat])
+        fw = np.asarray(self.fences)
+        live = fw[:, W, :] != INT32_MAX          # (S, NB)
+        extra = np.where(
+            live[:, None, :],
+            np.int32(np.uint32(BIAS).view(np.int32)),  # biased zero word
+            np.int32(PAD_WORD),
+        )
+        fw2 = np.concatenate(
+            [
+                fw[:, :W],
+                np.broadcast_to(extra, (S, new_words - W, fw.shape[2])),
+                fw[:, W:],
+            ],
+            axis=1,
         )
         self.n_words = new_words
         self.max_key_bytes = 4 * new_words
-        self._shard_state(widened, np.asarray(self.n))
+        counts = np.asarray(self.counts)
+        self._shard_state(widened, counts, fw2, np.asarray(self.btree),
+                          np.asarray(self.n))
+        self._fences_enc = []
+        for s in range(S):
+            nbl = int((counts[s] > 0).sum())
+            self._fences_enc.append(
+                encode_packed_words(fw2[s, :new_words, :nbl].T,
+                                    fw2[s, new_words, :nbl])
+            )
 
-    def _grow(self, min_capacity: int) -> None:
-        from .packing import state_pad_block
+    # -- shard_map steps --
 
-        new_cap = next_pow2(min_capacity, minimum=self.capacity * 2)
-        pad = new_cap - self.capacity
-        S, W = self.n_shards, self.n_words
-        hmat = np.asarray(self.hmat)
-        block = np.broadcast_to(state_pad_block(W, pad), (S, W + 2, pad))
-        hmat = np.concatenate([hmat, block], axis=2)
-        self.capacity = new_cap
-        self._shard_state(hmat, np.asarray(self.n))
+    def _build_block_step(self, lay, K: int):
+        import jax
+        from jax import lax
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from .tpu import _resolve_block_kernel_impl
+
+        a = self.axis
+        NB, B = self.NB, self.B
+
+        def body(hmat, counts, btree, fences, n, fused):
+            h, c, bt, n_o, st = _resolve_block_kernel_impl(
+                hmat[0], counts[0], btree[0], fences[0], n[0], fused[0],
+                lay=lay, K=K, NB=NB, B=B,
+            )
+            # Proxy-side verdict merge as an ICI collective: any shard's
+            # CONFLICT/TOO_OLD wins (MasterProxyServer.actor.cpp:431-447).
+            # Trailing aux bytes under the pmax: overflow and the clamped
+            # phase-2 round byte survive (both are value-max over single
+            # bytes); the per-shard new_n bytes do not (counts ride n_o).
+            st_g = lax.pmax(st, a)
+            return h[None], c[None], bt[None], n_o[None], st_g[None]
+
+        step = shard_map(
+            body,
+            mesh=self.mesh,
+            in_specs=(P(a, None, None), P(a, None), P(a, None),
+                      P(a, None, None), P(a), P(a, None)),
+            out_specs=(P(a, None, None), P(a, None), P(a, None), P(a),
+                       P(a, None)),
+            check_rep=False,
+        )
+        # State buffers are donated: the touched-block scatter-back updates
+        # every shard's hmat in place (same O(C)-copy avoidance as the
+        # single-chip fast kernel). fences are read-only here — not donated.
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def _build_compact_step(self, lay, NB_out: int):
+        import jax
+        from jax import lax
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from .tpu import _compact_resolve_impl
+
+        a = self.axis
+        NB, B = self.NB, self.B
+
+        def body(hmat, counts, fused):
+            h, c, bt, f, n_o, st = _compact_resolve_impl(
+                hmat[0], counts[0], fused[0], lay=lay, NB=NB,
+                NB_out=NB_out, B=B,
+            )
+            st_g = lax.pmax(st, a)
+            return h[None], c[None], bt[None], f[None], n_o[None], st_g[None]
+
+        step = shard_map(
+            body,
+            mesh=self.mesh,
+            in_specs=(P(a, None, None), P(a, None), P(a, None)),
+            out_specs=(P(a, None, None), P(a, None), P(a, None),
+                       P(a, None, None), P(a), P(a, None)),
+            check_rep=False,
+        )
+        return jax.jit(step)
+
+    # -- resolution --
 
     def resolve(
         self,
@@ -252,14 +440,16 @@ class ShardedConflictSetTPU:
     ) -> ConflictBatchResult:
         from jax.sharding import PartitionSpec as P
 
+        from ..core.knobs import SERVER_KNOBS
+        from .tpu import _touched_blocks
+
         oldest_eff = max(self.oldest_version, new_oldest_version)
-        version_off = version - self.oldest_version
-        oldest_off = oldest_eff - self.oldest_version
-        if not (0 <= version_off < 2**31):
+        if not (0 <= version - self._base < 2**31):
             raise ValueError(
                 "resolve version outside the int32 window relative to "
-                f"oldest_version {self.oldest_version}"
+                f"device base {self._base}"
             )
+        self._refresh_mirror()
 
         # Host-side proxy work: clip per shard, pack to common shapes. Row
         # counts come from the same flatten_batch that pack_batch uses, so
@@ -281,7 +471,6 @@ class ShardedConflictSetTPU:
             max(max(counts_r), r_cap), max(max(counts_w), w_cap), t_bucket,
             er_cap, ew_cap,
         )
-        max_writes = max(counts_w)
 
         while True:
             try:
@@ -322,25 +511,113 @@ class ShardedConflictSetTPU:
             max(p.n_expl_r for p in packed),
             max(p.n_expl_w for p in packed),
         )
-        for pb in packed:
-            pb.set_scalars(version_off, oldest_off)
-        fused = self._put(
-            np.stack([pb.buf for pb in packed]), P(self.axis, None)
+
+        # Rank each shard's write endpoints against ITS fence mirror: the
+        # per-shard touched-block sets and pessimistic insert bounds (the
+        # single-chip dispatch logic, once per shard).
+        touched_l, inc_l = [], []
+        for s, pb in enumerate(packed):
+            touched, inc = _touched_blocks(
+                self._fences_enc[s], pb.wb_enc, pb.we_enc, pb.n_writes
+            )
+            touched_l.append(touched)
+            inc_l.append(inc)
+        max_touched = max(len(t) for t in touched_l)
+
+        need_slow = (
+            self._since_compact + 1 >= SERVER_KNOBS.TPU_COMPACT_EVERY_BATCHES
+            or version - self._base >= 1 << 30
+            or next_bucket(max(max_touched, 1))
+            > SERVER_KNOBS.TPU_MAX_TOUCHED_BLOCKS
+            or any(
+                bool(np.any(
+                    self._fills[s, : len(self._fences_enc[s])] + inc_l[s]
+                    > self.B - 1
+                ))
+                or int(self._fills[s].sum()) + 2 * packed[s].n_writes + 1
+                >= self.NB * self.B
+                for s in range(self.n_shards)
+            )
         )
+        version_off = version - self._base
+        oldest_off = oldest_eff - self._base
+        delta = self.oldest_version - self._base  # pb.base -> device base
 
-        # Pre-grow so per-shard overflow cannot happen (each committed write
-        # adds at most 2 entries to its shard).
-        need = int(np.asarray(self.n).max()) + 2 * max_writes
-        if need >= self.capacity:
-            self._grow(need + 1)
+        if need_slow:
+            # Amortized compaction + dense resolve, ALL shards together (NB
+            # must stay common across the mesh): canonicalize, merge,
+            # redistribute at fill F, refresh the mirrors lazily from the
+            # kernel's fence/count readback. NB_out is sized by the widest
+            # shard so every shard's canonical set fits at fill F.
+            m_pred = max(
+                int(self._fills[s].sum()) + 2 * packed[s].n_writes
+                for s in range(self.n_shards)
+            )
+            NB_need = next_pow2(max(-(-(m_pred + 1) // self.F) + 1, 8))
+            NB_out = max(NB_need, self.min_NB)
+            if NB_out < self.NB and NB_out * 4 > self.NB:
+                NB_out = self.NB  # shrink hysteresis
+            if NB_out > self.NB:
+                self._grow_blocks(NB_out)
+            for pb in packed:
+                pb.set_scalars(version_off, oldest_off)
+                if delta:
+                    pb.buf[lay.off_tsnap: lay.off_tsnap + lay.T] += delta
+            fused = self._put(
+                np.stack([pb.buf for pb in packed]), P(self.axis, None)
+            )
+            key = ("cmp", lay.key(), self.NB, NB_out, self.B)
+            step = self._steps.get(key)
+            if step is None:
+                step = self._steps[key] = self._build_compact_step(lay, NB_out)
+            out = step(self.hmat, self.counts, fused)
+            (self.hmat, self.counts, self.btree, self.fences, self.n,
+             st) = out
+            self.NB = NB_out
+            self._base = oldest_eff
+            self._since_compact = 0
+            self._pending_mirror = (self.fences, self.counts)
+            self._fills = None  # stale until _refresh_mirror
+        else:
+            k_nat = next_bucket(max(max_touched, 1))
+            K = min(
+                max(k_nat, self._sticky.k_cap_for(len(txns), self.n_shards)),
+                self.NB,
+            )
+            self._sticky.update_k(
+                len(txns), min(k_nat, self.NB), self.n_shards
+            )
+            bufs = []
+            for s, pb in enumerate(packed):
+                g = np.full(K, self.NB, dtype=np.int32)
+                g[: len(touched_l[s])] = touched_l[s]
+                buf2 = np.concatenate(
+                    [pb.buf, g,
+                     np.array([len(touched_l[s])], dtype=np.int32)]
+                )
+                buf2[lay.off_scalars] = version_off
+                buf2[lay.off_scalars + 1] = oldest_off
+                if delta:
+                    buf2[lay.off_tsnap: lay.off_tsnap + lay.T] += delta
+                bufs.append(buf2)
+            fused = self._put(np.stack(bufs), P(self.axis, None))
+            key = ("blk", lay.key(), K, self.NB, self.B)
+            step = self._steps.get(key)
+            if step is None:
+                step = self._steps[key] = self._build_block_step(lay, K)
+            out = step(self.hmat, self.counts, self.btree, self.fences,
+                       self.n, fused)
+            self.hmat, self.counts, self.btree, self.n, st = out
+            for s in range(self.n_shards):
+                self._fills[s, : len(self._fences_enc[s])] += inc_l[s]
+            self._since_compact += 1
 
-        step = self._steps.get(lay.key())
-        if step is None:
-            step = self._steps[lay.key()] = self._build_step(lay)
-        hmat, n, st = step(self.hmat, self.n, fused)
         st_h = np.asarray(st)[0]
-        if bool(st_h[lay.T + 4]):  # pragma: no cover - pre-growth makes this dead
-            raise RuntimeError("sharded conflict set overflow despite pre-growth")
-        self.hmat, self.n = hmat, n
+        if bool(st_h[lay.T + 4]):  # pragma: no cover - host bounds make this dead
+            raise RuntimeError(
+                "sharded conflict set overflow despite the host headroom "
+                "bounds"
+            )
+        self.last_p2_iters = int(st_h[lay.T + 5])  # max across shards (pmax)
         self.oldest_version = oldest_eff
         return ConflictBatchResult([int(s) for s in st_h[: len(txns)]])

@@ -329,7 +329,27 @@ def _phase2_fixed_point(base_conf, *, smat, q_begin, q_end, s_begin, s_end,
                         rtxn, wtxn, w_valid, T, Wr, P2):
     """Intra-batch fixed point (checkIntraBatchConflicts) — pure batch
     geometry, no history state; shared by both kernels. Returns the per-txn
-    conflict vector (>=1 means CONFLICT or TOO_OLD carried in base_conf)."""
+    conflict vector (>=1 means CONFLICT or TOO_OLD carried in base_conf)
+    and the round count (doubling rounds + verification iterations).
+
+    LOG-DEPTH (r7): the naive fixed point re-applies the one-round operator
+    F (read -> min COMMITTED covering writer -> evidence) until it stops
+    changing; an abort cascade — t0 commits, t1 reads t0's write and
+    aborts, freeing t2, which aborts t3, ... — settles one link per round,
+    so scan-heavy batches iterated to ~chain-length depth (the YCSB-E
+    bottleneck). The rewrite seeds the loop with a Wyllie pointer-jumping
+    pass over the read -> min-POTENTIAL-writer chain: where a txn's reads
+    have (at most) one potential covering writer, its verdict is a
+    composition of per-link step functions (const-0 at base conflicts, NOT
+    along a live link, const-1 at chainless txns), and composing those
+    links by pointer doubling resolves every chain in ceil(log2 T) rounds.
+    Multi-writer reads make the seed approximate, so the original
+    while_loop still runs to the (unique) fixed point — it verifies the
+    seed in ONE round on pure chains and repairs it where the one-parent
+    reduction undershot; the old T+2 cap is kept as the exactness
+    backstop, so verdicts are bit-identical to the sequential reference
+    on every input.
+    """
     i32 = jnp.int32
     # Derived-on-device position metadata (cheaper than widening the H2D).
     # Write-begin slots come straight from s_begin (pad rows included,
@@ -349,20 +369,59 @@ def _phase2_fixed_point(base_conf, *, smat, q_begin, q_end, s_begin, s_end,
     # per loop iteration.
     anc = (q_begin[None, :] + P2) >> jnp.arange(k_levels, dtype=i32)[:, None]
 
-    def body(carry):
-        conflict, _, it = carry
-        committed_w = w_valid & (conflict[wtxn] == 0)
-        wval = jnp.where(committed_w, wtxn, _I32_INF).astype(i32)
-        # Case A: writes beginning strictly inside the read's span.
+    def min_writer_per_read(wval):
+        """Per read: min wval over covering writes — writes beginning
+        strictly inside the read's span (case A) plus writes covering the
+        read's begin position (case B, interval-tree stab)."""
         case_a = _table_range_query(
             _build_table(wval[perm_w], jnp.minimum, _I32_INF),
             lo_r, hi_r, jnp.minimum, _I32_INF,
         )
-        # Case B: writes covering the read's begin position.
         wval_rep = jnp.broadcast_to(wval, (n_blocks, Wr)).reshape(-1)
         tree_l = jnp.full(2 * P2, _I32_INF, dtype=i32).at[wnodes].min(wval_rep)
         stab = jnp.min(tree_l[anc], axis=0)
-        min_writer = jnp.minimum(case_a, stab)
+        return jnp.minimum(case_a, stab)
+
+    # ---- Pointer-doubling seed over the read -> min-potential-writer
+    # chain (same gathers as one F round, commit mask dropped). parent[t] =
+    # min earlier writer covering ANY read of t; sentinel T = no parent.
+    pot = min_writer_per_read(jnp.where(w_valid, wtxn, _I32_INF).astype(i32))
+    pot = jnp.where(pot < rtxn, pot, _I32_INF)
+    parent = jnp.full(T + 1, _I32_INF, dtype=i32).at[rtxn].min(pot)[:T]
+    has_par = parent < _I32_INF
+    ptr = jnp.concatenate(
+        [jnp.where(has_par, parent, T), jnp.full(1, T, dtype=i32)]
+    )
+    # Per-txn link function over committed-ness D = NOT conflict, as the
+    # value table (a, b) = (f(parent D=0), f(parent D=1)): base conflict ->
+    # const 0, live link -> NOT, chainless -> const 1. Sentinel = identity.
+    base_b = base_conf > 0
+    a = jnp.concatenate(
+        [jnp.where(base_b, 0, 1).astype(i32), jnp.zeros(1, dtype=i32)]
+    )
+    b = jnp.concatenate(
+        [jnp.where(base_b | has_par, 0, 1).astype(i32),
+         jnp.ones(1, dtype=i32)]
+    )
+    n_jump = max((T - 1).bit_length(), 1)
+
+    def jump(_, carry):
+        a, b, ptr = carry
+        ap, bp = a[ptr], b[ptr]
+        # Compose f_t after f_parent: new table = f_t evaluated at the
+        # parent's table entries.
+        return (jnp.where(ap == 1, b, a), jnp.where(bp == 1, b, a),
+                ptr[ptr])
+
+    a, b, ptr = lax.fori_loop(0, n_jump, jump, (a, b, ptr))
+    seed = jnp.maximum(base_conf, 1 - a[:T])
+
+    def body(carry):
+        conflict, _, it = carry
+        committed_w = w_valid & (conflict[wtxn] == 0)
+        min_writer = min_writer_per_read(
+            jnp.where(committed_w, wtxn, _I32_INF).astype(i32)
+        )
         evidence = (min_writer < rtxn).astype(i32)
         ev_txn = jnp.zeros(T, dtype=i32).at[rtxn].max(evidence)
         new_conflict = jnp.maximum(base_conf, ev_txn)
@@ -371,12 +430,12 @@ def _phase2_fixed_point(base_conf, *, smat, q_begin, q_end, s_begin, s_end,
 
     def cond(carry):
         _, changed, it = carry
-        return changed & (it < T + 2)
+        return changed & (it < n_jump + T + 2)
 
-    conflict, _, _ = lax.while_loop(
-        cond, body, (base_conf, jnp.array(True), jnp.int32(0))
+    conflict, _, iters = lax.while_loop(
+        cond, body, (seed, jnp.array(True), jnp.int32(n_jump))
     )
-    return conflict
+    return conflict, iters
 
 
 def _resolve_kernel_impl(hmat, n, fused, *, lay: FusedLayout):
@@ -413,7 +472,7 @@ def _resolve_kernel_impl(hmat, n, fused, *, lay: FusedLayout):
     base_conf = jnp.maximum(hist_conf, too_old.astype(i32))
 
     # ============ Phase 2: intra-batch fixed point ============
-    conflict = _phase2_fixed_point(
+    conflict, p2_iters = _phase2_fixed_point(
         base_conf, smat=smat, q_begin=q_begin, q_end=q_end,
         s_begin=s_begin, s_end=s_end, rtxn=rtxn, wtxn=wtxn,
         w_valid=w_valid, T=T, Wr=Wr, P2=P2,
@@ -575,7 +634,9 @@ def _resolve_kernel_impl(hmat, n, fused, *, lay: FusedLayout):
         jnp.where(conflict > 0, jnp.int8(CONFLICT), jnp.int8(COMMITTED)),
     )
     # ONE readback array per resolve: statuses ++ new_n (4 LE bytes) ++
-    # overflow. Every host-visible result rides a single small int8 D2H —
+    # overflow ++ phase-2 round count (one clamped byte, so the sharded
+    # pmax verdict merge is also a max over the per-shard round counts).
+    # Every host-visible result rides a single small int8 D2H —
     # on a tunneled link each separate fetch pays the full ~100 ms round
     # trip, so statuses and aux must not be separate arrays; and
     # collect_results() can concat several batches' st_aux into one fetch.
@@ -583,7 +644,8 @@ def _resolve_kernel_impl(hmat, n, fused, *, lay: FusedLayout):
         jnp.right_shift(new_n, jnp.array([0, 8, 16, 24], dtype=i32)) & 0xFF
     ).astype(jnp.int8)
     st_aux = jnp.concatenate(
-        [statuses, nn_bytes, overflow.astype(jnp.int8)[None]]
+        [statuses, nn_bytes, overflow.astype(jnp.int8)[None],
+         jnp.minimum(p2_iters, 127).astype(jnp.int8)[None]]
     )
     return hmat_out, new_n, st_aux
 
@@ -686,7 +748,7 @@ def _resolve_block_kernel_impl(hmat, counts, btree, fences, n, fused, *,
     base_conf = jnp.maximum(hist_conf, too_old.astype(i32))
 
     # ============ Phase 2: intra-batch fixed point (shared) ============
-    conflict = _phase2_fixed_point(
+    conflict, p2_iters = _phase2_fixed_point(
         base_conf, smat=smat, q_begin=q_begin, q_end=q_end,
         s_begin=s_begin, s_end=s_end, rtxn=rtxn, wtxn=wtxn,
         w_valid=w_valid, T=T, Wr=Wr, P2=P2,
@@ -843,7 +905,8 @@ def _resolve_block_kernel_impl(hmat, counts, btree, fences, n, fused, *,
         jnp.right_shift(n_out, jnp.array([0, 8, 16, 24], dtype=i32)) & 0xFF
     ).astype(jnp.int8)
     st_aux = jnp.concatenate(
-        [statuses, nn_bytes, overflow.astype(jnp.int8)[None]]
+        [statuses, nn_bytes, overflow.astype(jnp.int8)[None],
+         jnp.minimum(p2_iters, 127).astype(jnp.int8)[None]]
     )
     return hmat_out, counts_out, bt, n_out, st_aux
 
@@ -937,6 +1000,58 @@ def _compact_resolve_impl(hmat, counts, fused, *, lay: FusedLayout,
     return out, counts_o, bt, fences_o, new_n, st_aux
 
 
+def _touched_blocks(fences_enc: np.ndarray, wb_enc, we_enc, nw: int):
+    """Rank a batch's write endpoints against a host fence mirror: returns
+    (touched block ids, pessimistic per-block insert bound). Touched =
+    every endpoint's own block plus interiors fully covered by a write
+    range; the bound assumes all-novel distinct keys, so it can only
+    over-prove the headroom a dispatch needs. Shared by the single-chip
+    and mesh-sharded dispatch paths (the latter runs it once per shard)."""
+    nbl = len(fences_enc)
+    if not nw:
+        return np.zeros(0, dtype=np.int64), np.zeros(nbl, dtype=np.int64)
+    enc = np.concatenate([wb_enc, we_enc])
+    bids = np.searchsorted(fences_enc, enc, side="right").astype(np.int64) - 1
+    _, uix = np.unique(enc, return_index=True)
+    inc = np.bincount(bids[uix], minlength=nbl)
+    a = np.searchsorted(fences_enc, wb_enc, side="left")
+    b = np.searchsorted(fences_enc, we_enc, side="right")
+    cov = np.zeros(nbl + 1, dtype=np.int64)
+    np.add.at(cov, a, 1)
+    np.add.at(cov, np.maximum(a, b - 1), -1)
+    covered = np.nonzero(np.cumsum(cov[:nbl]) > 0)[0]
+    touched = np.unique(np.concatenate([bids, covered]))
+    return touched, inc
+
+
+def canonical_entries(hmat: np.ndarray, counts: np.ndarray, n_words: int,
+                      B: int, base: int, oldest_version: int):
+    """Canonicalize one block-sparse state's host copy into the oracle's
+    entries() form: absolute versions, stale clamp vs the logical horizon,
+    duplicate keys last-wins, equal-value coalesce. Shared by the
+    single-chip set and the mesh-sharded per-shard readout."""
+    from .packing import encode_packed_words
+
+    NB = counts.shape[0]
+    W = n_words
+    k = np.arange(NB).repeat(B)
+    j = np.tile(np.arange(B), NB)
+    cols = np.nonzero(j < counts[k])[0]  # block order == key order
+    kw = hmat[:W, cols]
+    lens = hmat[W, cols]
+    v = hmat[W + 1, cols].astype(np.int64)
+    absv = np.where(v > 0, v + base, 0)
+    absv = np.where(absv <= oldest_version, 0, absv)
+    enc = encode_packed_words(kw.T, lens)
+    last = np.concatenate([enc[1:] != enc[:-1], [True]])
+    kw, lens, absv = kw[:, last], lens[last], absv[last]
+    keep = np.concatenate([[True], absv[1:] != absv[:-1]])
+    idx = np.nonzero(keep)[0]
+    return [
+        (unpack_key(kw[:, i], int(lens[i])), int(absv[i])) for i in idx
+    ]
+
+
 _KERNEL_CACHE: dict = {}
 
 
@@ -1007,6 +1122,9 @@ class PendingResolve:
         u = arr[self._t_pad : self._t_pad + 4].view(np.uint8).astype(np.uint32)
         new_n = int(u[0] | (u[1] << 8) | (u[2] << 16) | (u[3] << 24))
         overflow = bool(arr[self._t_pad + 4])
+        # Phase-2 round count (clamped to one byte on device): exposed for
+        # the bench's per-leg iteration telemetry and the log-depth tests.
+        self._cs.last_p2_iters = int(arr[self._t_pad + 5])
         if overflow:  # pragma: no cover - host pre-growth makes this dead
             # The kernel output (already installed for pipelining) silently
             # dropped entries past capacity; nothing downstream of it can be
@@ -1150,6 +1268,7 @@ class ConflictSetTPU:
         self._dispatch_seq = 0
         self._result_seq = 0
         self._poisoned = False
+        self.last_p2_iters = None  # phase-2 rounds of the last resulted batch
 
     # -- introspection --
 
@@ -1174,27 +1293,10 @@ class ConflictSetTPU:
         CANONICALIZED (stale clamp vs the logical horizon, duplicate keys
         last-wins, equal-value coalesce), so it is bit-identical to the
         oracle's entries() even between compactions."""
-        from .packing import encode_packed_words
-
-        hmat = np.asarray(self.hmat)
-        counts = np.asarray(self.counts)
-        W, B = self.n_words, self.B
-        k = np.arange(self.NB).repeat(B)
-        j = np.tile(np.arange(B), self.NB)
-        cols = np.nonzero(j < counts[k])[0]  # block order == key order
-        kw = hmat[:W, cols]
-        lens = hmat[W, cols]
-        v = hmat[W + 1, cols].astype(np.int64)
-        absv = np.where(v > 0, v + self._base, 0)
-        absv = np.where(absv <= self.oldest_version, 0, absv)
-        enc = encode_packed_words(kw.T, lens)
-        last = np.concatenate([enc[1:] != enc[:-1], [True]])
-        kw, lens, absv = kw[:, last], lens[last], absv[last]
-        keep = np.concatenate([[True], absv[1:] != absv[:-1]])
-        idx = np.nonzero(keep)[0]
-        return [
-            (unpack_key(kw[:, i], int(lens[i])), int(absv[i])) for i in idx
-        ]
+        return canonical_entries(
+            np.asarray(self.hmat), np.asarray(self.counts), self.n_words,
+            self.B, self._base, self.oldest_version,
+        )
 
     # -- host mirror --
 
@@ -1314,23 +1416,8 @@ class ConflictSetTPU:
         # touched-block set, the covered-interior blocks of wide writes,
         # and the pessimistic (all-novel, distinct-key) per-block insert
         # bound that proves headroom before dispatch.
-        if nw:
-            enc = np.concatenate([pb.wb_enc, pb.we_enc])
-            bids = np.searchsorted(
-                self._fences_enc, enc, side="right"
-            ).astype(np.int64) - 1
-            ue, uix = np.unique(enc, return_index=True)
-            inc = np.bincount(bids[uix], minlength=nbl)
-            a = np.searchsorted(self._fences_enc, pb.wb_enc, side="left")
-            b = np.searchsorted(self._fences_enc, pb.we_enc, side="right")
-            cov = np.zeros(nbl + 1, dtype=np.int64)
-            np.add.at(cov, a, 1)
-            np.add.at(cov, np.maximum(a, b - 1), -1)
-            covered = np.nonzero(np.cumsum(cov[:nbl]) > 0)[0]
-            touched = np.unique(np.concatenate([bids, covered]))
-        else:
-            inc = np.zeros(nbl, dtype=np.int64)
-            touched = np.zeros(0, dtype=np.int64)
+        touched, inc = _touched_blocks(self._fences_enc, pb.wb_enc,
+                                       pb.we_enc, nw)
 
         m_bound = int(self._fills.sum())
         need_slow = (
@@ -1338,6 +1425,12 @@ class ConflictSetTPU:
             or bool(np.any(self._fills[:nbl] + inc > self.B - 1))
             or version - self._base >= 1 << 30
             or m_bound + 2 * nw + 1 >= self.NB * self.B
+            # Touched-block cap: a batch spraying more blocks than the knob
+            # allows takes the compaction (dense) path instead of compiling
+            # an outsized gather bucket (sim-randomized to exercise the
+            # fallback; the default never binds a sane deployment).
+            or next_bucket(max(len(touched), 1))
+            > SERVER_KNOBS.TPU_MAX_TOUCHED_BLOCKS
         )
         delta = pb.base - self._base
 

@@ -48,6 +48,22 @@ _KNOB_RANGES = [
     ("BACKUP_SHIP_RETRY_INTERVAL", "server", (0.05, 1.0)),
     ("HTTP_REQUEST_TIMEOUT", "client", (5.0, 60.0)),
     ("TPU_COMPACT_EVERY_BATCHES", "server", (2, 32)),
+    # r7: touched-block gather cap — low draws force the block-sparse
+    # resolvers (single-chip AND mesh-sharded) onto the compaction
+    # fallback mid-workload, the shape-churn path a fixed default never
+    # exercises.
+    ("TPU_MAX_TOUCHED_BLOCKS", "server", (8, 64)),
+]
+
+# Categorical knob draws (same subset-randomization policy as the ranges).
+# CONFLICT_SET_IMPL swaps the resolver backend recruited at every tier
+# (resolver/factory.py) under the seed's workload mix — the tpu draw runs
+# Cycle+Attrition specs through the block-sparse kernel (and, with the
+# randomized TPU_MAX_TOUCHED_BLOCKS above, through its compaction
+# fallback), which no fixed-default spec did. Weighted toward the deployed
+# default so most seeds still exercise the native detector.
+_KNOB_CHOICES = [
+    ("CONFLICT_SET_IMPL", "server", ("native", "native", "oracle", "tpu")),
 ]
 
 _REPLICATION_FOR = {3: ["single", "double", "triple"],
@@ -83,6 +99,10 @@ def generate_config(seed: int) -> dict[str, Any]:
             knobs[f"{reg}:{name}"] = rng.randint(lo, hi)
         else:
             knobs[f"{reg}:{name}"] = round(lo + rng.random() * (hi - lo), 5)
+    for name, reg, choices in _KNOB_CHOICES:
+        if rng.random() < 0.5:
+            continue
+        knobs[f"{reg}:{name}"] = rng.choice(choices)
 
     workloads: list[dict[str, Any]] = [
         {"name": "Cycle", "nodes": rng.randint(8, 24),
