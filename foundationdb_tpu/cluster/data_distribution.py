@@ -182,7 +182,7 @@ async def _move_keys_fetch_finish(cluster, r, new_team, old_slices,
     # -- finish: flip readability + the map --
     for t in new_team:
         cluster.storages[t].set_owned(r.begin, r.end, True)
-    for t in old_members - set(new_team):
+    for t in sorted(old_members - set(new_team)):
         s = cluster.storages[t]
         s.set_owned(r.begin, r.end, False)
         # Unassign FIRST: in-flight union-tagged mutations must not

@@ -103,7 +103,7 @@ class TagPartitionedLogSystem:
                    epoch: int = 0) -> None:
         per_log: list[list[TaggedMutation]] = [[] for _ in self.logs]
         for tm in tagged_mutations:
-            for i in {t % len(self.logs) for t in tm.tags}:
+            for i in sorted({t % len(self.logs) for t in tm.tags}):
                 per_log[i].append(tm)
         # Every log gets every version (possibly empty) so every chain
         # advances; durability = all logs durable (the commit's fsync

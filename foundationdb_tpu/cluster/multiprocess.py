@@ -698,7 +698,7 @@ class RemoteLogSystem:
                    tagged_mutations, epoch: int = 0) -> None:
         per_log: list[list] = [[] for _ in range(self.n_logs)]
         for tm in tagged_mutations:
-            for i in {t % self.n_logs for t in tm.tags}:
+            for i in sorted({t % self.n_logs for t in tm.tags}):
                 per_log[i].append(tm)
         reqs = []
         for stream, batch in zip(self._commit, per_log):
@@ -1246,6 +1246,7 @@ def run_role_host(role_class: str, cluster_file: str, datadir: str,
         if spec is None:
             import time as _t
 
+            # fdblint: allow[det-sleep] -- real-OS-process startup: polls the shared cluster file before any event loop exists; this host entry point only ever runs on the real-clock multiprocess tier.
             _t.sleep(0.05)
     # A pinned per-class port (spec["ports"]) keeps the address stable
     # across process restarts, so peers' cached addresses stay valid (the

@@ -311,7 +311,9 @@ class ShardedKVCluster:
             TraceEvent("ShardMoveNotDurable", severity=30).detail(
                 "Range", repr((r.begin, r.end))
             ).log()
-        donor = self.storages[next(iter(old_teams))[0]]
+        # Deterministic donor pick: old_teams is a set, and the donor
+        # choice must be a pure function of the seed, not PYTHONHASHSEED.
+        donor = self.storages[min(old_teams)[0]]
         rows = donor.data.get_range(r.begin, r.end, donor.version.get())
         for t in new_team:
             s = self.storages[t]
@@ -321,7 +323,7 @@ class ShardedKVCluster:
                     s._log_durable_set(k, v, s.version.get())
             s.set_owned(r.begin, r.end, True)
             s.set_assigned(r.begin, r.end, True)
-        for team in old_teams:
+        for team in sorted(old_teams):
             for t in team:
                 if t not in new_team:
                     self.storages[t].set_owned(r.begin, r.end, False)

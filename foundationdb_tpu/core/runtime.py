@@ -266,15 +266,22 @@ class SimClock(Clock):
 
 
 class RealClock(Clock):
+    """The real-clock tier's Clock. Simulated loops always get SimClock
+    (sim_loop); RealClock is never attached under simulation, so its wall
+    reads/sleeps are the INetwork seam's legitimate real-time half."""
+
     def __init__(self):
+        # fdblint: allow[det-wall-clock] -- RealClock IS the real-time implementation behind the Clock seam; sim paths use SimClock.
         self._origin = _time.monotonic()
 
     def now(self) -> float:
+        # fdblint: allow[det-wall-clock] -- RealClock IS the real-time implementation behind the Clock seam; sim paths use SimClock.
         return _time.monotonic() - self._origin
 
     def advance_to(self, t: float) -> None:
         remaining = t - self.now()
         if remaining > 0:
+            # fdblint: allow[det-sleep] -- the real-clock loop's idle wait (ref: Net2 sleep); SimClock.advance_to jumps instead, so simulation never reaches this sleep.
             _time.sleep(remaining)
 
     def is_simulated(self) -> bool:

@@ -41,6 +41,7 @@ class SystemMonitor:
         self.interval = interval
         self._task: Optional[Task] = None
         self._last_tasks_run = 0
+        # fdblint: allow[det-wall-clock] -- WallSeconds is operator telemetry only (trace detail); no scheduling or protocol decision reads it, so sim replays stay seed-pure.
         self._last_wall = time.monotonic()
 
     def start(self) -> "SystemMonitor":
@@ -53,6 +54,7 @@ class SystemMonitor:
 
     def emit_once(self) -> None:
         loop = current_loop()
+        # fdblint: allow[det-wall-clock] -- WallSeconds is operator telemetry only (trace detail); no scheduling or protocol decision reads it, so sim replays stay seed-pure.
         wall = time.monotonic()
         ev = TraceEvent("ProcessMetrics")
         for k, v in _read_proc_self().items():

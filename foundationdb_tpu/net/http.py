@@ -222,8 +222,10 @@ def http_request_sync(host: str, port: int, method: str, path: str,
     ex = _Exchange(reactor, host, port, method, path, headers, body,
                    result.append)
     ex.start()
+    # fdblint: allow[det-wall-clock] -- http_request_sync drives its own private SelectReactor on the calling OS thread (real-clock tier by construction); the sim tier uses the async form through the loop's timers.
     deadline = _time.monotonic() + timeout
     while not result:
+        # fdblint: allow[det-wall-clock] -- same private-reactor deadline as above; unreachable from a simulated loop.
         if _time.monotonic() > deadline:
             ex.cancel(TimedOut(ex.label))
             raise TimedOut(f"HTTP {ex.label}")

@@ -45,6 +45,7 @@ class SelectReactor:
                 # doesn't busy-spin between timer checks.
                 import time
 
+                # fdblint: allow[det-sleep] -- real-clock tier only: a reactor is attached solely by real_loop_with_transport; simulated loops never construct one (sim deliveries ride the timer heap), so this sleep is unreachable from simulation.
                 time.sleep(timeout)
             return False
         try:

@@ -19,7 +19,13 @@ import os
 import struct
 from typing import Optional
 
-PAGE_SIZE = 4096
+# Page size is knob-declared (set --knob_disk_queue_page_bytes before the
+# first import to change the on-disk layout; existing files only recover
+# under the page size they were written with — like the reference's
+# _PAGE_SIZE, fdbserver/DiskQueue.actor.cpp:112).
+from ..core.knobs import SERVER_KNOBS
+
+PAGE_SIZE = int(SERVER_KNOBS.DISK_QUEUE_PAGE_BYTES)
 MAGIC = 0x46445154
 HEADER = struct.Struct("<IQII")  # magic, seq, len, crc
 PAYLOAD_MAX = PAGE_SIZE - HEADER.size

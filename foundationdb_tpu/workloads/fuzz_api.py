@@ -121,6 +121,7 @@ class FuzzApiWorkload:
                 tr.set(b"fuzz/udc2", b"v")  # must refuse mid-commit
             finally:
                 try:
+                    # fdblint: allow[async-await-in-finally] -- joining the spawned commit is the point of the probe (commit must finish before the actor exits); a cancel landing here is absorbed by the except below, which is the intended teardown.
                     await t.done
                 except BaseException:  # noqa: BLE001
                     pass

@@ -193,6 +193,7 @@ class BackupRestoreWorkload:
         from .. import backup as bk
 
         for path in self.images:
+            # fdblint: allow[async-blocking] -- check() runs in the tester's validation phase after the workload stops; it inspects finished snapshot container files, not a serving path.
             with open(path, "rb") as f:
                 f.read(len(bk.MAGIC) + 8)  # header: magic + version
                 rows = dict(bk._read_recs(f))

@@ -1,0 +1,525 @@
+"""fdblint: per-rule true-positive/true-negative fixtures + the tier-1
+full-tree gate.
+
+Every rule pack gets a paired fixture: a bad snippet the rule MUST flag
+and a good twin it MUST NOT.  The final test runs the linter over the
+real tree (the same invocation as ``python -m tools.fdblint
+foundationdb_tpu tests``) and asserts zero unsuppressed findings — the
+static gate that keeps new wall-clock reads, leaked coroutines, donated-
+buffer reuse, and knob typos out of sim-reachable code.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from tools.fdblint import core as fdbcore
+from tools.fdblint.core import RULES, lint_paths, main
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_lint(tmp_path, files: dict[str, str], baseline=None):
+    """Write ``files`` under tmp_path and lint them; returns findings."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return lint_paths([str(tmp_path)], root=str(tmp_path),
+                      baseline=baseline or {})
+
+
+def rules_of(findings, *, active_only=True):
+    return sorted({f.rule for f in findings
+                   if not (active_only and f.suppressed)})
+
+
+# -- sim-reachable path for determinism fixtures (the pack only applies
+# under foundationdb_tpu/) --
+SIM = "foundationdb_tpu/mod.py"
+
+
+# ---------------------------------------------------------------------------
+# pack 1: determinism
+# ---------------------------------------------------------------------------
+
+def test_det_wall_clock_bad(tmp_path):
+    fs = run_lint(tmp_path, {SIM: """
+        import time
+        def f():
+            return time.time()
+    """})
+    assert rules_of(fs) == ["det-wall-clock"]
+
+
+def test_det_wall_clock_good_runtime_now(tmp_path):
+    fs = run_lint(tmp_path, {SIM: """
+        from foundationdb_tpu.core.runtime import now
+        def f():
+            return now()
+    """})
+    assert rules_of(fs) == []
+
+
+def test_det_sleep_bad_and_aliased(tmp_path):
+    fs = run_lint(tmp_path, {SIM: """
+        import time as _t
+        from time import sleep
+        def f():
+            _t.sleep(1)
+            sleep(2)
+    """})
+    assert [f.rule for f in fs if not f.suppressed] == ["det-sleep"] * 2
+
+
+def test_det_sleep_outside_sim_scope_ignored(tmp_path):
+    # tests/tools are not sim-reachable: the determinism pack skips them.
+    fs = run_lint(tmp_path, {"tests/helper.py": """
+        import time
+        def f():
+            time.sleep(1)
+    """})
+    assert rules_of(fs) == []
+
+
+def test_det_random_bad(tmp_path):
+    fs = run_lint(tmp_path, {SIM: """
+        import os
+        import random
+        def f():
+            return random.random(), random.randint(0, 3), os.urandom(4)
+    """})
+    assert [f.rule for f in fs if not f.suppressed] == ["det-random"] * 3
+
+
+def test_det_random_good_seeded_and_shadowed(tmp_path):
+    fs = run_lint(tmp_path, {SIM: """
+        import random
+        def f(random2):
+            rng = random.Random(42)        # explicit seed: fine
+            return rng.random(), rng.choice([1, 2])
+
+        def g(random):
+            # parameter shadowing the module name (DeterministicRandom
+            # instances are passed around as `random`): not the module.
+            return random.random01(), random.random_int(0, 3)
+    """})
+    assert rules_of(fs) == []
+
+
+def test_det_random_unseeded_ctor_flagged(tmp_path):
+    fs = run_lint(tmp_path, {SIM: """
+        import random
+        def f():
+            return random.Random()   # OS-entropy seeded
+    """})
+    assert rules_of(fs) == ["det-random"]
+
+
+def test_det_set_order_bad(tmp_path):
+    fs = run_lint(tmp_path, {SIM: """
+        def f(xs):
+            s = set(xs)
+            out = []
+            for x in s:
+                out.append(x)
+            return out, list({1, 2, 3}), ",".join({"a", "b"})
+    """})
+    assert [f.rule for f in fs if not f.suppressed] == ["det-set-order"] * 3
+
+
+def test_det_set_order_good_sorted_and_membership(tmp_path):
+    fs = run_lint(tmp_path, {SIM: """
+        def f(xs, y):
+            s = set(xs)
+            a = sorted(s)                 # ordered via sort: fine
+            b = y in s                    # membership: order-insensitive
+            c = len(s) + max(s)
+            for x in sorted(s | {y}):
+                c += x
+            return a, b, c
+    """})
+    assert rules_of(fs) == []
+
+
+# ---------------------------------------------------------------------------
+# pack 2: async hazards
+# ---------------------------------------------------------------------------
+
+def test_async_blocking_bad(tmp_path):
+    fs = run_lint(tmp_path, {"mod.py": """
+        import subprocess
+        import time
+        async def actor():
+            time.sleep(1)
+            subprocess.run(["ls"])
+            with open("/tmp/x") as f:
+                return f.read()
+    """})
+    assert [f.rule for f in fs if not f.suppressed] == ["async-blocking"] * 3
+
+
+def test_async_blocking_good_sync_fn_and_awaits(tmp_path):
+    fs = run_lint(tmp_path, {"mod.py": """
+        import time
+        def sync_helper():
+            # blocking in a plain function outside foundationdb_tpu/:
+            # not an actor, not sim-reachable.
+            time.sleep(0.1)
+            with open("/tmp/x") as f:
+                return f.read()
+        async def actor(loop):
+            await loop.delay(1.0)
+    """})
+    assert rules_of(fs) == []
+
+
+def test_async_unawaited_bad(tmp_path):
+    fs = run_lint(tmp_path, {"mod.py": """
+        async def work():
+            return 1
+        class Role:
+            async def serve(self):
+                return 2
+            async def run(self):
+                work()          # dropped coroutine
+                self.serve()    # dropped coroutine
+    """})
+    assert [f.rule for f in fs if not f.suppressed] == ["async-unawaited"] * 2
+
+
+def test_async_unawaited_good_awaited_or_spawned(tmp_path):
+    fs = run_lint(tmp_path, {"mod.py": """
+        async def work():
+            return 1
+        class Role:
+            async def serve(self):
+                return 2
+            async def run(self, spawn):
+                await work()
+                t = spawn(self.serve())
+                return t
+    """})
+    assert rules_of(fs) == []
+
+
+def test_async_await_in_finally_bad_good(tmp_path):
+    fs = run_lint(tmp_path, {"mod.py": """
+        async def bad(res):
+            try:
+                return 1
+            finally:
+                await res.close()
+        async def good(res):
+            try:
+                await res.use()
+            finally:
+                res.close_sync()
+    """})
+    assert rules_of(fs) == ["async-await-in-finally"]
+    assert [f.line for f in fs if not f.suppressed] == [6]
+
+
+# ---------------------------------------------------------------------------
+# pack 3: JAX kernel hazards
+# ---------------------------------------------------------------------------
+
+def test_jax_donated_reuse_bad(tmp_path):
+    fs = run_lint(tmp_path, {"mod.py": """
+        import jax
+
+        def _impl(state, batch):
+            return state + batch
+
+        def _kernel_for():
+            fn = jax.jit(_impl, donate_argnums=(0,))
+            return fn
+
+        class CS:
+            def resolve(self, batch):
+                fn = _kernel_for()
+                out = fn(self.state, batch)
+                return self.state.sum() + out   # read after donation
+    """})
+    assert rules_of(fs) == ["jax-donated-reuse"]
+
+
+def test_jax_donated_reuse_good_rebound(tmp_path):
+    fs = run_lint(tmp_path, {"mod.py": """
+        import jax
+
+        def _impl(state, batch):
+            return state + batch
+
+        def _kernel_for():
+            return jax.jit(_impl, donate_argnums=(0,))
+
+        class CS:
+            def resolve(self, batch):
+                fn = _kernel_for()
+                self.state = fn(self.state, batch)  # rebind kills the read
+                return self.state.sum()
+    """})
+    assert rules_of(fs) == []
+
+
+def test_jax_tracer_concrete_bad_interprocedural(tmp_path):
+    # taint flows jit root -> lambda -> named impl -> helper; kw-only
+    # statics stay untainted.
+    fs = run_lint(tmp_path, {"mod.py": """
+        import jax
+
+        def helper(q):
+            return q.item()
+
+        def _impl(x, y, *, n_static):
+            if x.sum() > 0:
+                y = y + 1
+            k = int(y[0])
+            return helper(x) + k
+
+        KERNEL = jax.jit(lambda a, b: _impl(a, b, n_static=4))
+    """})
+    assert [f.rule for f in fs if not f.suppressed] == \
+        ["jax-tracer-concrete"] * 3
+
+
+def test_jax_tracer_concrete_good_static_control(tmp_path):
+    fs = run_lint(tmp_path, {"mod.py": """
+        import jax
+        import jax.numpy as jnp
+
+        def _impl(x, *, n_static):
+            # Python control flow on statics and .shape reads is fine
+            # under trace; data-dependent selection goes through jnp.
+            if n_static > 2:
+                x = x * 2
+            for w in range(x.shape[0] and 3):
+                x = x + w
+            return jnp.where(x > 0, x, -x)
+
+        KERNEL = jax.jit(lambda a: _impl(a, n_static=4))
+
+        def driver(dev_out):
+            # host code (not jit-reachable): bool/int on arrays is fine
+            return int(dev_out[0]), bool(dev_out.any())
+    """})
+    assert rules_of(fs) == []
+
+
+def test_jax_host_sync_bad_in_traced_good_in_driver(tmp_path):
+    fs = run_lint(tmp_path, {"mod.py": """
+        import jax
+        import numpy as np
+
+        def _impl(x):
+            return np.asarray(x) + 1
+
+        KERNEL = jax.jit(_impl)
+
+        def driver(handle):
+            return np.asarray(handle)   # the legitimate D2H boundary
+    """})
+    assert rules_of(fs) == ["jax-host-sync"]
+    assert [f.line for f in fs if not f.suppressed] == [6]
+
+
+def test_jax_shard_map_body_reached(tmp_path):
+    fs = run_lint(tmp_path, {"mod.py": """
+        import jax
+        from jax.experimental.shard_map import shard_map
+
+        def build(mesh, spec):
+            def body(h, n):
+                if h.sum() > 0:      # tracer if inside shard_map body
+                    return h
+                return h + n
+            step = shard_map(body, mesh=mesh, in_specs=spec,
+                             out_specs=spec)
+            return jax.jit(step)
+    """})
+    assert rules_of(fs) == ["jax-tracer-concrete"]
+
+
+def test_jax_lax_while_body_reached(tmp_path):
+    fs = run_lint(tmp_path, {"mod.py": """
+        import jax
+        from jax import lax
+
+        def _impl(x):
+            def cond(c):
+                return bool(c[1])     # concretizes a traced carry
+            def body(c):
+                return (c[0] + 1, c[1])
+            return lax.while_loop(cond, body, (x, x.sum()))
+
+        KERNEL = jax.jit(_impl)
+    """})
+    assert rules_of(fs) == ["jax-tracer-concrete"]
+
+
+# ---------------------------------------------------------------------------
+# pack 4: knob coherence
+# ---------------------------------------------------------------------------
+
+KNOBS_SRC = """
+    class Knobs:
+        def init(self, name, value, sim_random_range=None):
+            setattr(self, name, value)
+
+    class ServerKnobs(Knobs):
+        def initialize(self, randomize, random):
+            init = self.init
+            init("LIVE_KNOB", 1)
+            init("RANDOMIZED_KNOB", 2)
+            init("STRING_REF_KNOB", 3)
+            init("DEAD_KNOB", 4)
+
+    class ClientKnobs(Knobs):
+        def initialize(self, randomize, random):
+            self.init("CLIENT_LIVE", 0.5)
+"""
+
+
+def test_knob_undeclared_and_dead(tmp_path):
+    fs = run_lint(tmp_path, {
+        "knobs.py": KNOBS_SRC,
+        "config.py": """
+            _KNOB_RANGES = [
+                ("RANDOMIZED_KNOB", "server", (1, 8)),
+                ("GHOST_KNOB", "server", (1, 8)),
+            ]
+        """,
+        "user.py": """
+            from .knobs import SERVER_KNOBS, CLIENT_KNOBS
+            def f(reg):
+                reg.set_knob("STRING_REF_KNOB", "9")
+                return (SERVER_KNOBS.LIVE_KNOB
+                        + SERVER_KNOBS.TYPO_KNOB
+                        + CLIENT_KNOBS.CLIENT_LIVE)
+        """,
+    })
+    got = [(f.rule, f.path) for f in fs if not f.suppressed]
+    assert ("knob-undeclared", "config.py") in got     # GHOST_KNOB
+    assert ("knob-undeclared", "user.py") in got       # TYPO_KNOB
+    assert ("knob-dead", "knobs.py") in got            # DEAD_KNOB
+    assert len(got) == 3  # LIVE/RANDOMIZED/STRING_REF/CLIENT_LIVE all ok
+
+
+def test_knob_clean_tree(tmp_path):
+    fs = run_lint(tmp_path, {
+        "knobs.py": KNOBS_SRC.replace('init("DEAD_KNOB", 4)\n', ""),
+        "config.py": """
+            _KNOB_RANGES = [("RANDOMIZED_KNOB", "server", (1, 8))]
+        """,
+        "user.py": """
+            from .knobs import SERVER_KNOBS, CLIENT_KNOBS
+            def f(reg):
+                reg.set_knob("STRING_REF_KNOB", "9")
+                return SERVER_KNOBS.LIVE_KNOB + CLIENT_KNOBS.CLIENT_LIVE
+        """,
+    })
+    assert rules_of(fs) == []
+
+
+# ---------------------------------------------------------------------------
+# pragmas, baseline, output modes
+# ---------------------------------------------------------------------------
+
+def test_pragma_suppresses_with_reason(tmp_path):
+    fs = run_lint(tmp_path, {SIM: """
+        import time
+        def f():
+            # fdblint: allow[det-sleep] -- real-clock tier, loop has no timers
+            time.sleep(1)
+    """})
+    assert rules_of(fs) == []
+    sup = [f for f in fs if f.suppressed]
+    assert len(sup) == 1 and sup[0].suppressed_by == "allow"
+
+
+def test_pragma_without_reason_is_flagged(tmp_path):
+    fs = run_lint(tmp_path, {SIM: """
+        import time
+        def f():
+            time.sleep(1)  # fdblint: allow[det-sleep]
+    """})
+    # the pragma is rejected AND the underlying finding stays active
+    assert rules_of(fs) == ["det-sleep", "pragma"]
+
+
+def test_pragma_unknown_rule_is_flagged(tmp_path):
+    fs = run_lint(tmp_path, {"mod.py": """
+        x = 1  # fdblint: allow[no-such-rule] -- whatever
+    """})
+    assert rules_of(fs) == ["pragma"]
+
+
+def test_allow_file_pragma(tmp_path):
+    fs = run_lint(tmp_path, {SIM: """
+        # fdblint: allow-file[det-wall-clock] -- wall-clock telemetry module
+        import time
+        def f():
+            return time.time() - time.monotonic()
+    """})
+    assert rules_of(fs) == []
+    assert {f.suppressed_by for f in fs if f.suppressed} == {"allow-file"}
+
+
+def test_baseline_budget(tmp_path):
+    files = {SIM: """
+        import time
+        def f():
+            return time.time(), time.monotonic()
+    """}
+    fs = run_lint(tmp_path, files,
+                  baseline={f"{SIM}::det-wall-clock": 1})
+    active = [f for f in fs if not f.suppressed]
+    assert [f.rule for f in active] == ["det-wall-clock"]  # 2 found, 1 budgeted
+    assert [f.suppressed_by for f in fs if f.suppressed] == ["baseline"]
+
+
+def test_cli_json_and_exit_codes(tmp_path, capsys):
+    bad = tmp_path / "foundationdb_tpu" / "mod.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text("import time\nx = time.time()\n")
+    rc = main([str(bad), "--root", str(tmp_path), "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["counts"]["active"] == 1
+    assert out["findings"][0]["rule"] == "det-wall-clock"
+
+    good = tmp_path / "foundationdb_tpu" / "ok.py"
+    good.write_text("y = 1\n")
+    rc = main([str(good), "--root", str(tmp_path)])
+    assert rc == 0
+
+
+def test_rules_registry_matches_readme():
+    readme = open(os.path.join(REPO_ROOT, "tools", "fdblint",
+                               "README.md")).read()
+    for rule in RULES:
+        assert f"`{rule}`" in readme, f"rule {rule} undocumented in README"
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate: the shipped tree is clean
+# ---------------------------------------------------------------------------
+
+def test_full_tree_is_clean():
+    """Mirror of `python -m tools.fdblint foundationdb_tpu tests`: zero
+    unsuppressed findings on the shipped tree.  New violations land here
+    first — fix them or pragma them with a justification at the site."""
+    findings = lint_paths(["foundationdb_tpu", "tests", "tools"],
+                          root=REPO_ROOT)
+    active = [f for f in findings if not f.suppressed]
+    assert not active, "fdblint violations:\n" + "\n".join(
+        f.render() for f in active)
+    # the pragma layer itself stays tight: every suppression is one of
+    # the audited inline allows, not an accumulating baseline.
+    assert all(f.suppressed_by in ("allow", "allow-file")
+               for f in findings if f.suppressed)

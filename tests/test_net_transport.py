@@ -174,6 +174,7 @@ def test_corrupt_frame_drops_connection():
 
         async def main():
             host, port = t_server.local_address.rsplit(":", 1)
+            # fdblint: allow[async-blocking] -- deliberately opens a raw blocking socket to inject a corrupt frame at the real transport server; localhost connect, test-only.
             raw = socket.create_connection((host, int(port)))
             payload = b"garbage-payload"
             raw.sendall(struct.pack("<II", len(payload), 12345) + payload)

@@ -1,0 +1,12 @@
+"""fdblint — determinism / async-hazard / JAX-shape / knob-coherence gate.
+
+The static-analysis equivalent of the reference's actor-compiler
+diagnostics (flow/actorcompiler/ActorCompiler.cs): the disciplines the
+deterministic simulator and the TPU kernels depend on — no wall clock or
+unseeded randomness on sim-reachable paths, no blocking calls or leaked
+coroutines in actors, no donated-buffer reuse or tracer leaks in jitted
+kernels, every knob reference declared — enforced over the whole tree
+instead of by convention.  See tools/fdblint/README.md.
+"""
+
+from .core import Finding, lint_paths, main  # noqa: F401

@@ -1,0 +1,317 @@
+"""fdblint pass framework: file contexts, pragmas, baseline, runner, CLI.
+
+A rule pack is a module exposing ``check(ctx) -> list[Finding]`` (per-file
+rules) and/or ``check_project(ctxs) -> list[Finding]`` (whole-tree rules).
+Packs register their rule ids in ``RULES`` so pragma references can be
+validated and the README stays honest.
+
+Suppression layers, innermost wins:
+
+  1. inline pragma   ``# fdblint: allow[rule-a,rule-b] -- reason``
+     on the flagged line (anywhere within a multi-line statement), or on a
+     standalone comment line directly above it.  The reason is mandatory.
+  2. file pragma     ``# fdblint: allow-file[rule] -- reason``
+     anywhere in the file; suppresses the rule for the whole file.
+  3. baseline        ``tools/fdblint/baseline.json`` — ``{"path::rule": N}``
+     accepts up to N findings of ``rule`` in ``path`` (for third-party or
+     bulk-migration debt; the shipped baseline is empty by policy).
+
+Suppressed findings are retained (``suppressed`` flag) so ``--json`` can
+audit the pragma layer; the exit code counts only unsuppressed ones.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import io
+import json
+import os
+import re
+import sys
+import tokenize
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+# rule id -> one-line description (the README's rule table is generated
+# from this registry; tests assert the two stay in sync).
+RULES: dict[str, str] = {
+    "det-wall-clock": "wall-clock read (time.time/monotonic, datetime.now) on a sim-reachable path",
+    "det-sleep": "blocking time.sleep on a sim-reachable path (use runtime delay())",
+    "det-random": "unseeded/global randomness (random.*, os.urandom, uuid4, np.random.*) on a sim-reachable path",
+    "det-set-order": "set iterated into an ordered output (iteration order is hash-seed dependent)",
+    "async-blocking": "blocking primitive (time.sleep, sync open(), subprocess) inside async def",
+    "async-unawaited": "coroutine created but neither awaited nor handed to spawn/Task",
+    "async-await-in-finally": "await inside finally without cancellation shielding",
+    "jax-donated-reuse": "buffer read after being donated to a jit(donate_argnums=...) call",
+    "jax-tracer-concrete": "Python bool()/int()/if/while/.item() on a tracer inside a jitted function",
+    "jax-host-sync": "host sync (np.asarray, .block_until_ready) inside a jitted function",
+    "knob-undeclared": "SERVER_KNOBS/CLIENT_KNOBS reference with no declaration in core/knobs.py",
+    "knob-dead": "knob declared in core/knobs.py but referenced nowhere",
+    "pragma": "malformed fdblint pragma (unknown rule id or missing '-- reason')",
+}
+
+_PRAGMA_RE = re.compile(
+    r"#\s*fdblint:\s*(allow|allow-file)\[([^\]]*)\]\s*(--\s*(\S.*))?"
+)
+
+
+@dataclass
+class Finding:
+    path: str
+    line: int
+    rule: str
+    message: str
+    end_line: int = 0
+    suppressed: bool = False
+    suppressed_by: str = ""
+
+    def __post_init__(self):
+        if not self.end_line:
+            self.end_line = self.line
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def as_json(self) -> dict:
+        return {
+            "path": self.path,
+            "line": self.line,
+            "rule": self.rule,
+            "message": self.message,
+            "suppressed": self.suppressed,
+            "suppressed_by": self.suppressed_by,
+        }
+
+
+@dataclass
+class FileCtx:
+    """One parsed source file plus its pragma index and import aliases."""
+
+    path: str                      # repo-relative, forward slashes
+    module: str                    # dotted module name (best effort)
+    source: str
+    tree: ast.Module
+    line_allows: dict[int, set[str]] = field(default_factory=dict)
+    file_allows: set[str] = field(default_factory=set)
+    pragma_findings: list[Finding] = field(default_factory=list)
+    # alias -> canonical dotted prefix, e.g. {"_t": "time", "np": "numpy",
+    # "sleep": "time.sleep"} built from every import statement in the file.
+    aliases: dict[str, str] = field(default_factory=dict)
+
+    # -- call-name resolution -------------------------------------------
+    def dotted(self, node: ast.AST) -> Optional[str]:
+        """Expr -> dotted path ('a.b.c') for Name/Attribute chains."""
+        parts: list[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            parts.append(node.id)
+            return ".".join(reversed(parts))
+        return None
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Dotted path with the leading alias canonicalized through the
+        file's imports: ``_t.sleep`` -> ``time.sleep``."""
+        d = self.dotted(node)
+        if d is None:
+            return None
+        head, _, rest = d.partition(".")
+        full = self.aliases.get(head)
+        if full is not None:
+            return full + ("." + rest if rest else "")
+        return d
+
+    def allows(self, rule: str, line: int, end_line: int = 0) -> Optional[str]:
+        if rule in self.file_allows:
+            return "allow-file"
+        for ln in range(line, (end_line or line) + 1):
+            if rule in self.line_allows.get(ln, ()):
+                return "allow"
+        return None
+
+
+def _collect_aliases(tree: ast.Module) -> dict[str, str]:
+    aliases: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[(a.asname or a.name.split(".")[0])] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    # numpy/jax conventions even when imported relatively or oddly
+    aliases.setdefault("np", "numpy")
+    aliases.setdefault("jnp", "jax.numpy")
+    return aliases
+
+
+def _comment_tokens(source: str):
+    """(line, column, text) for every real COMMENT token — pragma syntax
+    inside docstrings/string literals (e.g. this tool's own docs) must
+    never be parsed as a pragma."""
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                yield tok.start[0], tok.start[1], tok.string
+    except (tokenize.TokenizeError, IndentationError, SyntaxError):
+        return
+
+
+def _parse_pragmas(ctx: FileCtx) -> None:
+    lines = ctx.source.splitlines()
+    for i, col, text in _comment_tokens(ctx.source):
+        m = _PRAGMA_RE.search(text)
+        if m is None:
+            if "fdblint:" in text:
+                ctx.pragma_findings.append(Finding(
+                    ctx.path, i, "pragma",
+                    "unparseable fdblint pragma (expected "
+                    "'# fdblint: allow[rule] -- reason')"))
+            continue
+        kind, rules_s, _, reason = m.groups()
+        rules = {r.strip() for r in rules_s.split(",") if r.strip()}
+        bad = sorted(r for r in rules if r not in RULES)
+        if bad:
+            ctx.pragma_findings.append(Finding(
+                ctx.path, i, "pragma",
+                f"pragma names unknown rule(s): {', '.join(bad)}"))
+        rules &= set(RULES)
+        if not reason:
+            ctx.pragma_findings.append(Finding(
+                ctx.path, i, "pragma",
+                "pragma without justification (append '-- reason')"))
+            continue
+        if kind == "allow-file":
+            ctx.file_allows |= rules
+        else:
+            ctx.line_allows.setdefault(i, set()).update(rules)
+            # A comment-only line annotates the statement below it.
+            if i <= len(lines) and lines[i - 1][:col].strip() == "":
+                ctx.line_allows.setdefault(i + 1, set()).update(rules)
+
+
+def load_file(path: str, root: str) -> Optional[FileCtx]:
+    with open(path, "r", encoding="utf-8") as f:
+        source = f.read()
+    rel = os.path.relpath(path, root).replace(os.sep, "/")
+    try:
+        tree = ast.parse(source, filename=rel)
+    except SyntaxError as e:
+        ctx = FileCtx(rel, "", source, ast.Module(body=[], type_ignores=[]))
+        ctx.pragma_findings.append(Finding(
+            rel, e.lineno or 1, "pragma", f"file does not parse: {e.msg}"))
+        return ctx
+    mod = rel[:-3] if rel.endswith(".py") else rel
+    if mod.endswith("/__init__"):
+        mod = mod[: -len("/__init__")]
+    ctx = FileCtx(rel, mod.replace("/", "."), source, tree)
+    ctx.aliases = _collect_aliases(tree)
+    _parse_pragmas(ctx)
+    return ctx
+
+
+def collect_files(paths: Iterable[str], root: str) -> list[str]:
+    out: list[str] = []
+    for p in paths:
+        ap = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(ap):
+            out.append(ap)
+        else:
+            for dirpath, dirnames, filenames in os.walk(ap):
+                dirnames[:] = sorted(
+                    d for d in dirnames
+                    if d != "__pycache__" and not d.startswith(".")
+                )
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        out.append(os.path.join(dirpath, fn))
+    return out
+
+
+def _load_baseline(root: str) -> dict[str, int]:
+    bp = os.path.join(root, "tools", "fdblint", "baseline.json")
+    if not os.path.exists(bp):
+        return {}
+    with open(bp, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    return {str(k): int(v) for k, v in data.items()}
+
+
+def lint_paths(paths: Iterable[str], root: Optional[str] = None,
+               baseline: Optional[dict[str, int]] = None) -> list[Finding]:
+    """Run every rule pack over ``paths``; returns ALL findings with the
+    suppression layers applied (callers filter on ``.suppressed``)."""
+    from . import rules_async, rules_determinism, rules_jax, rules_knobs
+
+    root = os.path.abspath(root or os.getcwd())
+    ctxs = [c for c in (load_file(f, root) for f in collect_files(paths, root))
+            if c is not None]
+    findings: list[Finding] = []
+    for ctx in ctxs:
+        findings.extend(ctx.pragma_findings)
+        for pack in (rules_determinism, rules_async, rules_jax):
+            findings.extend(pack.check(ctx))
+    findings.extend(rules_knobs.check_project(ctxs))
+    findings.extend(rules_jax.check_project(ctxs))
+
+    by_path = {c.path: c for c in ctxs}
+    if baseline is None:
+        baseline = _load_baseline(root)
+    budget = dict(baseline)
+    for f in findings:
+        ctx = by_path.get(f.path)
+        if ctx is not None and f.rule != "pragma":
+            how = ctx.allows(f.rule, f.line, f.end_line)
+            if how:
+                f.suppressed, f.suppressed_by = True, how
+                continue
+        key = f"{f.path}::{f.rule}"
+        if budget.get(key, 0) > 0:
+            budget[key] -= 1
+            f.suppressed, f.suppressed_by = True, "baseline"
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.fdblint",
+        description="determinism / async-hazard / JAX-shape / knob lint gate",
+    )
+    ap.add_argument("paths", nargs="+", help="files or directories to lint")
+    ap.add_argument("--root", default=os.getcwd(),
+                    help="repo root paths are reported relative to")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output (includes suppressed)")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also print pragma/baseline-suppressed findings")
+    args = ap.parse_args(argv)
+
+    findings = lint_paths(args.paths, root=args.root)
+    active = [f for f in findings if not f.suppressed]
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.as_json() for f in findings],
+            "counts": {
+                "active": len(active),
+                "suppressed": sum(1 for f in findings if f.suppressed),
+            },
+        }, indent=2))
+    else:
+        shown = findings if args.show_suppressed else active
+        for f in shown:
+            tag = " (suppressed)" if f.suppressed else ""
+            print(f.render() + tag)
+        n_sup = sum(1 for f in findings if f.suppressed)
+        print(f"fdblint: {len(active)} finding(s), {n_sup} suppressed")
+    return 1 if active else 0
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via __main__.py
+    sys.exit(main())

@@ -1,0 +1,150 @@
+"""Rule pack 2 — async hazards in the actor runtime.
+
+The cooperative runtime (core/runtime.py) is single-threaded: one
+blocking call inside an actor stalls every role on the loop, and a
+coroutine that is created but never awaited/spawned silently does
+nothing (the static complement of the never-awaited RuntimeWarning
+promoted to an error in pytest.ini — that one only fires if GC happens
+to run under a test).  ``await`` inside ``finally`` runs during
+cancellation unwind: the awaiting actor can be cancelled AGAIN mid-
+cleanup, so such waits must be consciously shielded (and pragma'd).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import FileCtx, Finding
+
+BLOCKING_CALLS = {
+    "time.sleep": "blocks the whole event loop; await delay() instead",
+    "subprocess.run": "blocks the loop; spawn and poll via timers",
+    "subprocess.call": "blocks the loop; spawn and poll via timers",
+    "subprocess.check_call": "blocks the loop; spawn and poll via timers",
+    "subprocess.check_output": "blocks the loop; spawn and poll via timers",
+    "os.system": "blocks the loop; spawn and poll via timers",
+    "os.wait": "blocks the loop",
+    "os.waitpid": "blocks the loop (use os.WNOHANG and poll)",
+    "socket.create_connection": "blocking connect; use the transport layer",
+}
+
+# Calls that legitimately consume a coroutine object (handing it to the
+# runtime or the tester's actor pool).
+_COROUTINE_SINKS = {"spawn", "run", "run_until", "Task", "ensure_future",
+                    "create_task", "add_actor", "run_coroutine"}
+
+
+class _AsyncDefs(ast.NodeVisitor):
+    """Indexes async defs: module-visible names and per-class methods."""
+
+    def __init__(self):
+        self.names: set[str] = set()
+        self.methods: dict[str, set[str]] = {}
+        self._class: list[str] = []
+
+    def visit_ClassDef(self, node):  # noqa: N802
+        self._class.append(node.name)
+        self.generic_visit(node)
+        self._class.pop()
+
+    def visit_AsyncFunctionDef(self, node):  # noqa: N802
+        if self._class:
+            self.methods.setdefault(self._class[-1], set()).add(node.name)
+        else:
+            self.names.add(node.name)
+        self.generic_visit(node)
+
+
+class _Scan(ast.NodeVisitor):
+    def __init__(self, ctx: FileCtx, defs: _AsyncDefs):
+        self.ctx = ctx
+        self.defs = defs
+        self.findings: list[Finding] = []
+        self._func: list[ast.AST] = []   # enclosing function stack
+        self._class: list[str] = []
+        self._finally_depth = 0
+
+    # -- scope bookkeeping --
+    def visit_ClassDef(self, node):  # noqa: N802
+        self._class.append(node.name)
+        self.generic_visit(node)
+        self._class.pop()
+
+    def _visit_func(self, node):
+        self._func.append(node)
+        saved, self._finally_depth = self._finally_depth, 0
+        self.generic_visit(node)
+        self._finally_depth = saved
+        self._func.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+    visit_Lambda = _visit_func
+
+    def _in_async(self) -> bool:
+        return bool(self._func) and isinstance(
+            self._func[-1], ast.AsyncFunctionDef)
+
+    # -- async-blocking --
+    def visit_Call(self, node):  # noqa: N802
+        if self._in_async():
+            name = self.ctx.resolve(node.func)
+            why = BLOCKING_CALLS.get(name or "")
+            if why is None and name == "open":
+                why = ("synchronous file I/O stalls every actor on the "
+                       "loop; keep disk work behind the storage seam")
+            if why is not None:
+                self.findings.append(Finding(
+                    self.ctx.path, node.lineno, "async-blocking",
+                    f"{name}() inside async def: {why}",
+                    end_line=node.end_lineno or node.lineno))
+        self.generic_visit(node)
+
+    # -- async-unawaited --
+    def visit_Expr(self, node):  # noqa: N802
+        call = node.value
+        if isinstance(call, ast.Call):
+            target = None
+            fn = call.func
+            if isinstance(fn, ast.Name) and fn.id in self.defs.names:
+                target = fn.id
+            elif (isinstance(fn, ast.Attribute)
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id in ("self", "cls") and self._class
+                    and fn.attr in self.defs.methods.get(self._class[-1], ())):
+                target = fn.attr
+            if target is not None:
+                self.findings.append(Finding(
+                    self.ctx.path, node.lineno, "async-unawaited",
+                    f"coroutine {target}(...) is created and dropped — it "
+                    "never runs; await it or hand it to spawn()/Task",
+                    end_line=node.end_lineno or node.lineno))
+        self.generic_visit(node)
+
+    # -- async-await-in-finally --
+    def visit_Try(self, node):  # noqa: N802
+        for part in (node.body, node.handlers, node.orelse):
+            for child in part:
+                self.visit(child)
+        self._finally_depth += 1
+        for child in node.finalbody:
+            self.visit(child)
+        self._finally_depth -= 1
+
+    def visit_Await(self, node):  # noqa: N802
+        if self._finally_depth > 0:
+            self.findings.append(Finding(
+                self.ctx.path, node.lineno, "async-await-in-finally",
+                "await inside finally runs during cancellation unwind; a "
+                "second cancel aborts the cleanup mid-flight — shield it "
+                "or make the cleanup synchronous",
+                end_line=node.end_lineno or node.lineno))
+        self.generic_visit(node)
+
+
+def check(ctx: FileCtx) -> list[Finding]:
+    defs = _AsyncDefs()
+    defs.visit(ctx.tree)
+    scan = _Scan(ctx, defs)
+    scan.visit(ctx.tree)
+    return scan.findings

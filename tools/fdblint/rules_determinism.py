@@ -1,0 +1,239 @@
+"""Rule pack 1 — determinism on sim-reachable paths.
+
+Simulation replays are a pure function of the seed ONLY while every
+sim-reachable module routes time through the runtime clock
+(core/runtime.py now()/delay()) and randomness through core/rand.py
+(DeterministicRandom / g_random()).  One wall-clock read or global-RNG
+call on a path a simulated role can reach silently breaks
+seed-reproducibility of every chaos test.  Mirrors the reference's
+discipline (flow/DeterministicRandom.h, fdbrpc/sim2.actor.cpp).
+
+Applies only to modules under SIM_PACKAGES — tests/tools drive the
+simulator from outside and may use real time freely.  The real-clock tier
+inside the package (net/reactor.py, RealClock, multiprocess host glue)
+carries inline ``# fdblint: allow[...] -- reason`` pragmas instead: the
+exemption is visible and justified at the site.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from .core import FileCtx, Finding
+
+SIM_PACKAGES = ("foundationdb_tpu/",)
+
+WALL_CLOCK = {
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.datetime.today", "datetime.date.today",
+}
+
+# Explicitly-seeded constructions stay legal: DeterministicRandom wraps
+# random.Random(seed); sim/config derives per-seed specs the same way.
+_SEEDED_CTORS = {
+    "random.Random", "random.SystemRandom",
+    "numpy.random.default_rng", "numpy.random.RandomState",
+    "numpy.random.Generator", "numpy.random.SeedSequence",
+    "numpy.random.PCG64", "numpy.random.Philox",
+}
+
+_ORDERED_CALL_SINKS = {"list", "tuple", "enumerate", "iter", "reversed"}
+
+# stdlib `random` module functions (so a local object NAMED random —
+# e.g. a DeterministicRandom parameter — can never match).
+_RANDOM_FUNCS = {
+    "random", "randint", "randrange", "choice", "choices", "shuffle",
+    "sample", "uniform", "seed", "getrandbits", "randbytes", "gauss",
+    "betavariate", "expovariate", "normalvariate", "lognormvariate",
+    "vonmisesvariate", "paretovariate", "weibullvariate", "triangular",
+    "binomialvariate",
+}
+
+_SET_RETURNING_METHODS = {
+    "union", "intersection", "difference", "symmetric_difference", "copy",
+}
+
+
+def _in_scope(path: str) -> bool:
+    return any(path.startswith(p) for p in SIM_PACKAGES)
+
+
+def check(ctx: FileCtx) -> list[Finding]:
+    if not _in_scope(ctx.path):
+        return []
+    out: list[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        raw = ctx.dotted(node.func)
+        if raw is None or raw.partition(".")[0] not in ctx.aliases:
+            # the head name is not import-backed: a local object that
+            # merely shadows a module name (e.g. a DeterministicRandom
+            # parameter called `random`) must not match module rules.
+            continue
+        name = ctx.resolve(node.func)
+        f = _check_call(ctx, node, name)
+        if f is not None:
+            out.append(f)
+    out.extend(_check_set_order(ctx))
+    return out
+
+
+def _check_call(ctx: FileCtx, node: ast.Call, name: str) -> Optional[Finding]:
+    loc = dict(end_line=getattr(node, "end_lineno", node.lineno) or node.lineno)
+    if name == "time.sleep":
+        return Finding(
+            ctx.path, node.lineno, "det-sleep",
+            "time.sleep blocks the whole loop and reads real time; "
+            "await runtime delay() (sim jumps the clock, real mode sleeps)",
+            **loc)
+    if name in WALL_CLOCK:
+        return Finding(
+            ctx.path, node.lineno, "det-wall-clock",
+            f"{name}() on a sim-reachable path; use runtime now() "
+            "(virtual under simulation)", **loc)
+    if name in _SEEDED_CTORS:
+        if not node.args and not node.keywords:
+            return Finding(
+                ctx.path, node.lineno, "det-random",
+                f"{name}() without a seed is OS-entropy seeded; pass an "
+                "explicit seed or use core/rand.py", **loc)
+        return None
+    head, _, tail = name.partition(".")
+    if name == "os.urandom" or head in ("secrets",) or name in (
+            "uuid.uuid1", "uuid.uuid4"):
+        return Finding(
+            ctx.path, node.lineno, "det-random",
+            f"{name}() is OS entropy; route through core/rand.py "
+            "(DeterministicRandom / g_random())", **loc)
+    if head == "random" and tail in _RANDOM_FUNCS:
+        return Finding(
+            ctx.path, node.lineno, "det-random",
+            f"global {name}() shares an unseeded process-wide RNG; use "
+            "core/rand.py or an explicit random.Random(seed)", **loc)
+    if name.startswith("numpy.random.") and name not in _SEEDED_CTORS:
+        return Finding(
+            ctx.path, node.lineno, "det-random",
+            f"{name}() uses numpy's global RNG; use a seeded "
+            "numpy.random.default_rng(seed)", **loc)
+    return None
+
+
+# -- det-set-order ------------------------------------------------------
+
+
+class _ScopeSets(ast.NodeVisitor):
+    """Per-scope tracking of names that (statically) hold sets."""
+
+    def __init__(self, ctx: FileCtx, inherited: frozenset[str]):
+        self.ctx = ctx
+        self.inherited = inherited
+        self.set_names: set[str] = set(inherited)
+        self.nonset_names: set[str] = set()
+        self.findings: list[Finding] = []
+        self.children: list[tuple[ast.AST, frozenset[str]]] = []
+
+    # - set typing -
+    def is_set(self, node: ast.AST) -> bool:
+        if isinstance(node, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(node, ast.Name):
+            return node.id in self.set_names and node.id not in self.nonset_names
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Name) and fn.id in ("set", "frozenset"):
+                return True
+            if (isinstance(fn, ast.Attribute)
+                    and fn.attr in _SET_RETURNING_METHODS
+                    and self.is_set(fn.value)):
+                return True
+        if isinstance(node, ast.BinOp) and isinstance(
+                node.op, (ast.BitOr, ast.BitAnd, ast.Sub, ast.BitXor)):
+            return self.is_set(node.left) or self.is_set(node.right)
+        return False
+
+    def _bind(self, target: ast.AST, value: ast.AST) -> None:
+        if isinstance(target, ast.Name):
+            if self.is_set(value):
+                self.set_names.add(target.id)
+                self.nonset_names.discard(target.id)
+            else:
+                self.nonset_names.add(target.id)
+                self.set_names.discard(target.id)
+
+    # - scope boundaries: record, don't descend -
+    def _enter_child(self, node: ast.AST) -> None:
+        self.children.append((node, frozenset(self.set_names - self.nonset_names)))
+
+    def visit_FunctionDef(self, node):  # noqa: N802
+        self._enter_child(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def visit_ClassDef(self, node):  # noqa: N802
+        for stmt in node.body:
+            self.visit(stmt)
+
+    # - assignments -
+    def visit_Assign(self, node):  # noqa: N802
+        for t in node.targets:
+            self._bind(t, node.value)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):  # noqa: N802
+        if node.value is not None:
+            self._bind(node.target, node.value)
+        self.generic_visit(node)
+
+    # - sinks -
+    def _flag(self, node: ast.AST, what: str) -> None:
+        self.findings.append(Finding(
+            self.ctx.path, node.lineno, "det-set-order",
+            f"{what} iterates a set in hash order (PYTHONHASHSEED-"
+            "dependent); sort first or use an ordered container",
+            end_line=getattr(node, "end_lineno", node.lineno) or node.lineno))
+
+    def _iter_over_set(self, it: ast.AST) -> bool:
+        if self.is_set(it):
+            return True
+        if isinstance(it, (ast.GeneratorExp, ast.ListComp)):
+            return any(self.is_set(g.iter) for g in it.generators)
+        return False
+
+    def visit_For(self, node):  # noqa: N802
+        if self.is_set(node.iter):
+            self._flag(node, "for-loop")
+        self.generic_visit(node)
+
+    def visit_ListComp(self, node):  # noqa: N802
+        if any(self.is_set(g.iter) for g in node.generators):
+            self._flag(node, "list comprehension")
+        self.generic_visit(node)
+
+    def visit_Call(self, node):  # noqa: N802
+        fn = node.func
+        if (isinstance(fn, ast.Name) and fn.id in _ORDERED_CALL_SINKS
+                and node.args and self._iter_over_set(node.args[0])):
+            self._flag(node, f"{fn.id}()")
+        elif (isinstance(fn, ast.Attribute) and fn.attr == "join"
+                and node.args and self._iter_over_set(node.args[0])):
+            self._flag(node, "str.join()")
+        self.generic_visit(node)
+
+
+def _check_set_order(ctx: FileCtx) -> list[Finding]:
+    findings: list[Finding] = []
+    stack: list[tuple[ast.AST, frozenset[str]]] = [(ctx.tree, frozenset())]
+    while stack:
+        scope, inherited = stack.pop()
+        v = _ScopeSets(ctx, inherited)
+        body = scope.body if not isinstance(scope, ast.Lambda) else [scope.body]
+        for stmt in body:
+            v.visit(stmt)
+        findings.extend(v.findings)
+        stack.extend(v.children)
+    return findings

@@ -1,0 +1,638 @@
+"""Rule pack 3 — JAX kernel hazards.
+
+Three disciplines the block-sparse resolver kernels (resolver/tpu.py,
+resolver/sharded.py, resolver/rankfed.py) depend on:
+
+* jax-donated-reuse — a buffer passed at a ``donate_argnums`` position is
+  dead the moment the jitted call is dispatched; reading it afterwards
+  returns garbage (or deadlocks on some backends).  The pack tracks
+  functions that RETURN a donating ``jax.jit`` (the ``_kernel_for``
+  factory idiom), variables bound from them, and flags any read of a
+  donated argument after the donating call without an intervening
+  rebind.
+
+* jax-tracer-concrete — inside functions reachable from a ``jax.jit`` /
+  ``shard_map`` wrapping (including lambdas, ``functools.partial``
+  statics, and bodies handed to ``lax.while_loop``-style control flow),
+  a Python ``bool()``/``int()``/``float()``/``.item()`` or an
+  ``if``/``while`` test on a tracer-derived value forces concretization:
+  a trace-time error at best, a silent constant-fold at worst.  Taint
+  starts at the traced parameters and propagates through local
+  assignments and project-internal calls; ``.shape``/``.dtype``/
+  ``.ndim`` reads strip taint (static under tracing).
+
+* jax-host-sync — ``np.asarray``/``np.array`` on a traced value,
+  ``.block_until_ready()`` or ``jax.device_get`` anywhere inside a
+  traced function: host syncs belong at the annotated driver boundaries
+  (PendingResolve.result / collect_results), never inside a kernel.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .core import FileCtx, Finding
+
+_JIT_NAMES = {"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit"}
+_SHARD_MAP_NAMES = {
+    "jax.experimental.shard_map.shard_map",
+    "jax.experimental.shard_map",
+    "shard_map",
+}
+_PARTIAL_NAMES = {"functools.partial", "partial"}
+# lax control flow whose function arguments run under the trace.
+_TRACED_HOF = {
+    "jax.lax.while_loop", "jax.lax.fori_loop", "jax.lax.scan",
+    "jax.lax.cond", "jax.lax.switch", "jax.lax.map",
+    "jax.lax.associative_scan",
+    "lax.while_loop", "lax.fori_loop", "lax.scan", "lax.cond",
+    "lax.switch", "lax.map", "lax.associative_scan",
+}
+# Attribute reads that are static under tracing: taint does not flow out.
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize", "aval",
+                 "sharding"}
+_CONCRETIZERS = {"bool", "int", "float"}
+_HOST_SYNC_CALLS = {"numpy.asarray", "numpy.array", "numpy.copy"}
+
+
+# ---------------------------------------------------------------------------
+# Function index
+# ---------------------------------------------------------------------------
+
+@dataclass(eq=False)
+class FuncInfo:
+    ctx: FileCtx
+    node: ast.AST                      # FunctionDef / AsyncFunctionDef / Lambda
+    name: str                          # "" for lambdas
+    parent: Optional["FuncInfo"]       # lexically enclosing function
+    pos_params: list[str] = field(default_factory=list)
+    kw_params: list[str] = field(default_factory=list)
+    tainted: set[str] = field(default_factory=set)
+    closure_taint: set[str] = field(default_factory=set)
+    reachable: bool = False
+
+    @property
+    def label(self) -> str:
+        return self.name or f"<lambda:{self.node.lineno}>"
+
+
+def _params_of(node: ast.AST) -> tuple[list[str], list[str]]:
+    a = node.args
+    pos = [p.arg for p in getattr(a, "posonlyargs", [])] + [p.arg for p in a.args]
+    kw = [p.arg for p in a.kwonlyargs]
+    return pos, kw
+
+
+class _Indexer(ast.NodeVisitor):
+    """Collects every function in a module with its lexical parent."""
+
+    def __init__(self, ctx: FileCtx):
+        self.ctx = ctx
+        self.funcs: list[FuncInfo] = []
+        self.module_level: dict[str, FuncInfo] = {}
+        self.by_node: dict[ast.AST, FuncInfo] = {}
+        self._stack: list[FuncInfo] = []
+        self._depth = 0                # class nesting does not break lexical scope
+
+    def _add(self, node: ast.AST, name: str) -> FuncInfo:
+        pos, kw = _params_of(node)
+        fi = FuncInfo(self.ctx, node, name,
+                      self._stack[-1] if self._stack else None,
+                      pos_params=pos, kw_params=kw)
+        self.funcs.append(fi)
+        self.by_node[node] = fi
+        if not self._stack and name:
+            # module-level OR method: both resolvable by bare name inside
+            # the module (methods only via taint propagation on self calls,
+            # which we approximate by name).
+            self.module_level.setdefault(name, fi)
+        return fi
+
+    def _visit_func(self, node, name):
+        fi = self._add(node, name)
+        self._stack.append(fi)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    def visit_FunctionDef(self, node):  # noqa: N802
+        self._visit_func(node, node.name)
+
+    def visit_AsyncFunctionDef(self, node):  # noqa: N802
+        self._visit_func(node, node.name)
+
+    def visit_Lambda(self, node):  # noqa: N802
+        self._visit_func(node, "")
+
+
+# ---------------------------------------------------------------------------
+# Project-wide resolution
+# ---------------------------------------------------------------------------
+
+class _Project:
+    def __init__(self, ctxs: list[FileCtx]):
+        self.ctxs = ctxs
+        self.indexers: dict[str, _Indexer] = {}
+        self.modules: dict[str, FileCtx] = {}
+        for ctx in ctxs:
+            idx = _Indexer(ctx)
+            idx.visit(ctx.tree)
+            self.indexers[ctx.path] = idx
+            self.modules[ctx.module] = ctx
+        # per-file import map: local name -> (module_dotted, symbol)
+        self.imports: dict[str, dict[str, tuple[str, str]]] = {}
+        for ctx in ctxs:
+            self.imports[ctx.path] = self._imports_of(ctx)
+
+    def _imports_of(self, ctx: FileCtx) -> dict[str, tuple[str, str]]:
+        out: dict[str, tuple[str, str]] = {}
+        parts = ctx.module.split(".") if ctx.module else []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ImportFrom):
+                continue
+            if node.level > 0:
+                # relative: resolve against this module's package; try both
+                # the module and package interpretation of ctx.module.
+                bases = []
+                if len(parts) >= node.level:
+                    bases.append(parts[: len(parts) - node.level])
+                if len(parts) >= node.level - 1:
+                    bases.append(parts[: len(parts) - node.level + 1])
+                mod = None
+                for b in bases:
+                    cand = ".".join(b + ([node.module] if node.module else []))
+                    if cand in self.modules:
+                        mod = cand
+                        break
+                if mod is None:
+                    continue
+            else:
+                mod = node.module or ""
+                if mod not in self.modules:
+                    continue
+            for a in node.names:
+                if a.name != "*":
+                    out[a.asname or a.name] = (mod, a.name)
+        return out
+
+    def resolve_func(self, ctx: FileCtx, scope: Optional[FuncInfo],
+                     node: ast.AST) -> Optional[FuncInfo]:
+        """Resolve a call target to a project FuncInfo, searching enclosing
+        nested defs, the module's top-level defs, then imports."""
+        idx = self.indexers[ctx.path]
+        if isinstance(node, ast.Lambda):
+            return idx.by_node.get(node)
+        if isinstance(node, ast.Name):
+            name = node.id
+            # nested defs of enclosing functions, innermost first
+            s = scope
+            while s is not None:
+                for fi in idx.funcs:
+                    if fi.name == name and fi.parent is s:
+                        return fi
+                s = s.parent
+            if name in idx.module_level:
+                return idx.module_level[name]
+            imp = self.imports[ctx.path].get(name)
+            if imp is not None:
+                mod, sym = imp
+                octx = self.modules.get(mod)
+                if octx is not None:
+                    return self.indexers[octx.path].module_level.get(sym)
+            return None
+        if isinstance(node, ast.Attribute):
+            # self.method / module.func
+            if isinstance(node.value, ast.Name):
+                base = node.value.id
+                if base in ("self", "cls"):
+                    return idx.module_level.get(node.attr)
+                imp = self.imports[ctx.path].get(base)
+                if imp is not None:
+                    mod = ".".join(filter(None, (imp[0], imp[1])))
+                    octx = self.modules.get(mod) or self.modules.get(imp[0])
+                    if octx is not None:
+                        return self.indexers[octx.path].module_level.get(node.attr)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Taint analysis over the jit-reachable set
+# ---------------------------------------------------------------------------
+
+def _unwrap_partial(ctx: FileCtx, call: ast.Call):
+    """partial(f, *bound, **kwbound) -> (f-expr, n_bound_pos, kw_names)."""
+    if (isinstance(call, ast.Call)
+            and ctx.resolve(call.func) in _PARTIAL_NAMES and call.args):
+        return (call.args[0], len(call.args) - 1,
+                {k.arg for k in call.keywords if k.arg})
+    return None
+
+
+class _TaintEngine:
+    def __init__(self, project: _Project):
+        self.project = project
+        self.findings: list[Finding] = []
+        self._work: list[FuncInfo] = []
+        self._analyzed: dict[FuncInfo, frozenset[str]] = {}
+
+    # -- seeding --
+    def seed_roots(self) -> None:
+        for ctx in self.project.ctxs:
+            idx = self.project.indexers[ctx.path]
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                resolved = ctx.resolve(node.func)
+                if resolved in _JIT_NAMES or resolved in _SHARD_MAP_NAMES:
+                    if node.args:
+                        self._seed_root(ctx, idx, node.args[0])
+
+    def _seed_root(self, ctx: FileCtx, idx: _Indexer, fn_expr: ast.AST) -> None:
+        bound_pos, bound_kw = 0, set()
+        p = _unwrap_partial(ctx, fn_expr) if isinstance(fn_expr, ast.Call) else None
+        if p is not None:
+            fn_expr, bound_pos, bound_kw = p
+        scope = self._enclosing_scope(idx, fn_expr)
+        fi = self.project.resolve_func(ctx, scope, fn_expr)
+        if fi is None and isinstance(fn_expr, ast.Name):
+            # jit(step) where step = shard_map(body, ...): the shard_map
+            # call itself seeds `body`; nothing further to do here.
+            return
+        if fi is None:
+            return
+        taint = set(fi.pos_params[bound_pos:]) - bound_kw
+        self.mark(fi, taint, closure=set())
+
+    def _enclosing_scope(self, idx: _Indexer, node: ast.AST) -> Optional[FuncInfo]:
+        # cheap lexical lookup: the function whose span contains the node
+        best = None
+        for fi in idx.funcs:
+            n = fi.node
+            if (n.lineno <= node.lineno
+                    and (n.end_lineno or n.lineno) >= (node.lineno)):
+                if best is None or n.lineno >= best.node.lineno:
+                    if n is not node:
+                        best = fi
+        return best
+
+    def mark(self, fi: FuncInfo, taint: set[str], closure: set[str]) -> None:
+        before = (fi.reachable, frozenset(fi.tainted), frozenset(fi.closure_taint))
+        fi.reachable = True
+        fi.tainted |= taint
+        fi.closure_taint |= closure
+        if before != (True, frozenset(fi.tainted), frozenset(fi.closure_taint)):
+            self._work.append(fi)
+
+    # -- fixpoint --
+    def run(self) -> None:
+        self.seed_roots()
+        while self._work:
+            fi = self._work.pop()
+            key = frozenset(fi.tainted | fi.closure_taint)
+            if self._analyzed.get(fi) == key:
+                continue
+            self._analyzed[fi] = key
+            self._analyze(fi, report=False)
+        # final pass: report sinks with converged taint
+        for fi in list(self._analyzed):
+            self._analyze(fi, report=True)
+
+    # -- per-function analysis --
+    def _analyze(self, fi: FuncInfo, report: bool) -> None:
+        ctx = fi.ctx
+        idx = self.project.indexers[ctx.path]
+        tainted = set(fi.tainted) | set(fi.closure_taint)
+        body = (fi.node.body if not isinstance(fi.node, ast.Lambda)
+                else [ast.Expr(fi.node.body)])
+
+        own_nodes = self._own_nodes(fi, idx, body)
+
+        def texpr(e: ast.AST) -> bool:
+            return _expr_tainted(e, tainted)
+
+        # local fixpoint over assignments
+        for _ in range(10):
+            changed = False
+            for node in own_nodes:
+                new = None
+                if isinstance(node, ast.Assign) and texpr(node.value):
+                    new = node.targets
+                elif isinstance(node, ast.AnnAssign) and node.value is not None \
+                        and texpr(node.value):
+                    new = [node.target]
+                elif isinstance(node, ast.AugAssign) and (
+                        texpr(node.value) or texpr(node.target)):
+                    new = [node.target]
+                elif isinstance(node, ast.NamedExpr) and texpr(node.value):
+                    new = [node.target]
+                if new:
+                    for t in new:
+                        for n in ast.walk(t):
+                            if isinstance(n, ast.Name) and n.id not in tainted:
+                                tainted.add(n.id)
+                                changed = True
+            if not changed:
+                break
+
+        for node in own_nodes:
+            if isinstance(node, ast.Call):
+                self._handle_call(fi, node, tainted, report)
+            elif isinstance(node, (ast.If, ast.While)) and report:
+                if texpr(node.test):
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    self.findings.append(Finding(
+                        ctx.path, node.test.lineno, "jax-tracer-concrete",
+                        f"Python `{kind}` on a tracer-derived value in "
+                        f"jitted {fi.label}(); use lax.cond/lax.while_loop "
+                        "or jnp.where",
+                        end_line=node.test.end_lineno or node.test.lineno))
+
+    def _own_nodes(self, fi: FuncInfo, idx: _Indexer, body) -> list[ast.AST]:
+        """All AST nodes lexically in `fi`, excluding nested functions."""
+        out: list[ast.AST] = []
+        stack: list[ast.AST] = list(body)
+        while stack:
+            n = stack.pop()
+            out.append(n)
+            for c in ast.iter_child_nodes(n):
+                if c in idx.by_node:        # nested function: its own FuncInfo
+                    continue
+                stack.append(c)
+        return out
+
+    def _handle_call(self, fi: FuncInfo, node: ast.Call,
+                     tainted: set[str], report: bool) -> None:
+        ctx = fi.ctx
+        resolved = ctx.resolve(node.func)
+
+        def texpr(e: ast.AST) -> bool:
+            return _expr_tainted(e, tainted)
+
+        loc = dict(end_line=node.end_lineno or node.lineno)
+        if report:
+            # concretizers
+            if (isinstance(node.func, ast.Name)
+                    and node.func.id in _CONCRETIZERS
+                    and node.args and texpr(node.args[0])):
+                self.findings.append(Finding(
+                    ctx.path, node.lineno, "jax-tracer-concrete",
+                    f"{node.func.id}() on a tracer in jitted {fi.label}() "
+                    "forces concretization at trace time", **loc))
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("item", "tolist")
+                    and texpr(node.func.value)):
+                self.findings.append(Finding(
+                    ctx.path, node.lineno, "jax-tracer-concrete",
+                    f".{node.func.attr}() on a tracer in jitted "
+                    f"{fi.label}()", **loc))
+            # host syncs
+            if resolved in _HOST_SYNC_CALLS and node.args and texpr(node.args[0]):
+                self.findings.append(Finding(
+                    ctx.path, node.lineno, "jax-host-sync",
+                    f"{resolved}() on a traced value inside jitted "
+                    f"{fi.label}(); host syncs belong at the driver "
+                    "boundary (e.g. PendingResolve.result)", **loc))
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "block_until_ready"):
+                self.findings.append(Finding(
+                    ctx.path, node.lineno, "jax-host-sync",
+                    f".block_until_ready() inside jitted {fi.label}() is a "
+                    "host sync under trace", **loc))
+            if resolved in ("jax.device_get",):
+                self.findings.append(Finding(
+                    ctx.path, node.lineno, "jax-host-sync",
+                    f"jax.device_get inside jitted {fi.label}()", **loc))
+
+        # traced higher-order functions seed their function arguments
+        if resolved in _TRACED_HOF:
+            idx = self.project.indexers[ctx.path]
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                sub = self.project.resolve_func(ctx, fi, arg)
+                if sub is not None:
+                    self.mark(sub, set(sub.pos_params), closure=set(tainted))
+
+        # propagate into project-internal calls
+        callee = self.project.resolve_func(ctx, fi, node.func)
+        if callee is not None and callee is not fi:
+            new_taint: set[str] = set()
+            pos = callee.pos_params
+            args = node.args
+            # methods called as self.m(...): skip the `self` formal
+            if (isinstance(node.func, ast.Attribute) and pos
+                    and pos[0] in ("self", "cls")):
+                pos = pos[1:]
+            for i, a in enumerate(args):
+                if isinstance(a, ast.Starred):
+                    continue
+                if i < len(pos) and texpr(a):
+                    new_taint.add(pos[i])
+            all_params = set(callee.pos_params) | set(callee.kw_params)
+            for k in node.keywords:
+                if k.arg and k.arg in all_params and texpr(k.value):
+                    new_taint.add(k.arg)
+            if fi.reachable:
+                self.mark(callee, new_taint, closure=set())
+
+
+def _expr_tainted(node: ast.AST, tainted: set[str]) -> bool:
+    """True if any tainted name flows into the expression value.  Reads
+    through .shape/.dtype/.ndim-style attributes are static under tracing
+    and stop the flow."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, ast.Attribute) and n.attr in _STATIC_ATTRS:
+            continue
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+            if n.id in tainted:
+                return True
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+    return False
+
+
+# ---------------------------------------------------------------------------
+# jax-donated-reuse
+# ---------------------------------------------------------------------------
+
+def _donate_indices(ctx: FileCtx, call: ast.Call) -> Optional[tuple[int, ...]]:
+    if ctx.resolve(call.func) not in _JIT_NAMES:
+        return None
+    for k in call.keywords:
+        if k.arg == "donate_argnums":
+            try:
+                v = ast.literal_eval(k.value)
+            except ValueError:
+                return None
+            if isinstance(v, int):
+                return (v,)
+            if isinstance(v, (tuple, list)):
+                return tuple(int(x) for x in v)
+    return None
+
+
+class _DonationScan:
+    def __init__(self, project: _Project):
+        self.project = project
+        self.findings: list[Finding] = []
+        # (module, func name) -> donated indices for factory functions
+        self.producers: dict[tuple[str, str], tuple[int, ...]] = {}
+
+    def run(self) -> None:
+        for ctx in self.project.ctxs:
+            self._find_producers(ctx)
+        for ctx in self.project.ctxs:
+            idx = self.project.indexers[ctx.path]
+            for fi in idx.funcs:
+                if not isinstance(fi.node, ast.Lambda):
+                    self._scan_function(ctx, idx, fi)
+
+    def _find_producers(self, ctx: FileCtx) -> None:
+        idx = self.project.indexers[ctx.path]
+        for fi in idx.funcs:
+            if isinstance(fi.node, ast.Lambda) or not fi.name:
+                continue
+            jit_vars: dict[str, tuple[int, ...]] = {}
+            returns_idx: Optional[tuple[int, ...]] = None
+            for node in ast.walk(fi.node):
+                if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                    di = _donate_indices(ctx, node.value)
+                    if di:
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                jit_vars[t.id] = di
+                elif isinstance(node, ast.Return) and node.value is not None:
+                    if isinstance(node.value, ast.Call):
+                        di = _donate_indices(ctx, node.value)
+                        if di:
+                            returns_idx = di
+                    elif isinstance(node.value, ast.Name) \
+                            and node.value.id in jit_vars:
+                        returns_idx = jit_vars[node.value.id]
+            if returns_idx:
+                self.producers[(ctx.module, fi.name)] = returns_idx
+
+    def _producer_indices(self, ctx: FileCtx, call: ast.Call
+                          ) -> Optional[tuple[int, ...]]:
+        di = _donate_indices(ctx, call)
+        if di:
+            return di
+        fn = call.func
+        name = None
+        if isinstance(fn, ast.Name):
+            name = fn.id
+        elif isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+            if fn.value.id in ("self", "cls"):
+                name = fn.attr
+            else:
+                imp = self.project.imports[ctx.path].get(fn.value.id)
+                if imp is not None:
+                    return self.producers.get((imp[0], fn.attr))
+        if name is None:
+            return None
+        hit = self.producers.get((ctx.module, name))
+        if hit is not None:
+            return hit
+        imp = self.project.imports[ctx.path].get(name)
+        if imp is not None:
+            return self.producers.get(imp)
+        return None
+
+    def _scan_function(self, ctx: FileCtx, idx: _Indexer, fi: FuncInfo) -> None:
+        # vars bound to donating callables in this function
+        donating_vars: dict[str, tuple[int, ...]] = {}
+        calls: list[tuple[ast.Call, tuple[int, ...]]] = []
+        own = self._own(fi, idx)
+        for node in own:
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                di = self._producer_indices(ctx, node.value)
+                if di:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            donating_vars[t.id] = di
+        for node in own:
+            if isinstance(node, ast.Call):
+                di = None
+                if isinstance(node.func, ast.Name):
+                    di = donating_vars.get(node.func.id)
+                if di is None and isinstance(node.func, ast.Call):
+                    di = self._producer_indices(ctx, node.func)
+                if di:
+                    calls.append((node, di))
+        if not calls:
+            return
+        loads, stores = self._uses(fi, idx)
+        for call, indices in calls:
+            call_end = (call.end_lineno or call.lineno,
+                        getattr(call, "end_col_offset", 0))
+            for i in indices:
+                if i >= len(call.args):
+                    continue
+                path = ctx.dotted(call.args[i])
+                if path is None:
+                    continue
+                for lpos, lnode in loads.get(path, []):
+                    if lpos <= call_end:
+                        continue
+                    killed = any(call_end < spos <= lpos
+                                 for spos, _ in stores.get(path, []))
+                    if not killed:
+                        self.findings.append(Finding(
+                            ctx.path, lnode.lineno, "jax-donated-reuse",
+                            f"`{path}` was donated to the jitted call at "
+                            f"line {call.lineno} (donate_argnums) and read "
+                            "afterwards without a rebind — the buffer is "
+                            "invalidated by donation",
+                            end_line=lnode.end_lineno or lnode.lineno))
+                        break
+
+    def _own(self, fi: FuncInfo, idx: _Indexer) -> list[ast.AST]:
+        out, stack = [], list(fi.node.body)
+        while stack:
+            n = stack.pop()
+            out.append(n)
+            for c in ast.iter_child_nodes(n):
+                if c in idx.by_node:
+                    continue
+                stack.append(c)
+        return out
+
+    def _uses(self, fi: FuncInfo, idx: _Indexer):
+        loads: dict[str, list] = {}
+        stores: dict[str, list] = {}
+        for node in self._own(fi, idx):
+            if isinstance(node, (ast.Name, ast.Attribute)):
+                path = fi.ctx.dotted(node)
+                if path is None:
+                    continue
+                if isinstance(node.ctx, ast.Store):
+                    # An assignment target executes AFTER its RHS: place
+                    # the store at end-of-line so `self.x = fn(self.x)`
+                    # kills reads on later lines, not the donated arg.
+                    pos = (node.end_lineno or node.lineno, 1 << 30)
+                    stores.setdefault(path, []).append((pos, node))
+                elif isinstance(node.ctx, ast.Load):
+                    pos = (node.lineno, node.col_offset)
+                    loads.setdefault(path, []).append((pos, node))
+        for d in (loads, stores):
+            for v in d.values():
+                v.sort(key=lambda t: t[0])
+        return loads, stores
+
+
+# ---------------------------------------------------------------------------
+# pack entry points
+# ---------------------------------------------------------------------------
+
+def check(ctx: FileCtx) -> list[Finding]:
+    return []  # all three rules need the project-wide index
+
+
+def check_project(ctxs: list[FileCtx]) -> list[Finding]:
+    project = _Project(list(ctxs))
+    engine = _TaintEngine(project)
+    engine.run()
+    donation = _DonationScan(project)
+    donation.run()
+    return engine.findings + donation.findings

@@ -1,0 +1,152 @@
+"""Rule pack 4 — knob coherence.
+
+Knobs are the deployment and simulation control surface: a typo'd
+``SERVER_KNOBS.X`` raises AttributeError only on the (possibly rare)
+path that reads it, a randomization entry for an undeclared knob makes
+``set_knob`` throw mid-sim, and a declared-but-unreferenced knob is a
+lie in the operator-facing registry.  This pack cross-checks the three
+layers whole-tree:
+
+* every ``SERVER_KNOBS.X`` / ``CLIENT_KNOBS.X`` attribute reference
+  resolves to an ``init("X", ...)`` declaration in core/knobs.py
+  (knob-undeclared);
+* every knob named in a randomization table (``_KNOB_RANGES`` /
+  ``_KNOB_CHOICES``-style module constants pairing a name with a
+  "server"/"client" registry tag, e.g. sim/config.py) is declared
+  (knob-undeclared);
+* every declared knob is referenced somewhere — attribute access,
+  randomization entry, or any string literal naming it (``set_knob`` /
+  ``--knob_x`` style); otherwise knob-dead, reported at the declare
+  site.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import FileCtx, Finding
+
+_REGISTRY_GLOBALS = {
+    "SERVER_KNOBS": "server",
+    "CLIENT_KNOBS": "client",
+}
+
+
+def _declarations(ctxs: list[FileCtx]) -> dict[str, dict[str, int]]:
+    """registry ('server'/'client') -> {knob name: declare lineno}, from
+    any ``class *Knobs`` whose methods call ``init("NAME", ...)``."""
+    decls: dict[str, dict[str, int]] = {"server": {}, "client": {}}
+    for ctx in ctxs:
+        for cls in ast.walk(ctx.tree):
+            if not (isinstance(cls, ast.ClassDef) and cls.name.endswith("Knobs")):
+                continue
+            reg = ("server" if cls.name.startswith("Server")
+                   else "client" if cls.name.startswith("Client") else None)
+            if reg is None:
+                continue
+            for node in ast.walk(cls):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, (ast.Name, ast.Attribute))
+                        and (node.func.id if isinstance(node.func, ast.Name)
+                             else node.func.attr) == "init"
+                        and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    decls[reg][node.args[0].value] = node.lineno
+    return decls
+
+
+def _attr_refs(ctx: FileCtx) -> list[tuple[str, str, ast.Attribute]]:
+    """(registry, knob, node) for every SERVER_KNOBS.X-style access."""
+    out = []
+    for node in ast.walk(ctx.tree):
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id in _REGISTRY_GLOBALS
+                and node.attr.isupper()):
+            out.append((_REGISTRY_GLOBALS[node.value.id], node.attr, node))
+    return out
+
+
+def _randomization_entries(ctx: FileCtx) -> list[tuple[str, str, int]]:
+    """(registry, knob, lineno) from module-level randomization tables:
+    lists of tuples whose first two elements are (knob-name str,
+    'server'|'client')."""
+    out = []
+    for node in ctx.tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        value = node.value
+        if not isinstance(value, (ast.List, ast.Tuple)):
+            continue
+        for el in value.elts:
+            if (isinstance(el, ast.Tuple) and len(el.elts) >= 2
+                    and isinstance(el.elts[0], ast.Constant)
+                    and isinstance(el.elts[0].value, str)
+                    and isinstance(el.elts[1], ast.Constant)
+                    and el.elts[1].value in ("server", "client")):
+                out.append((el.elts[1].value, el.elts[0].value, el.lineno))
+    return out
+
+
+def check_project(ctxs: list[FileCtx]) -> list[Finding]:
+    decls = _declarations(ctxs)
+    if not decls["server"] and not decls["client"]:
+        return []  # knobs.py not in the scanned set: nothing to check
+    decl_files = {c.path for c in ctxs
+                  if any(isinstance(n, ast.ClassDef) and n.name.endswith("Knobs")
+                         for n in ast.walk(c.tree))}
+    findings: list[Finding] = []
+    referenced: dict[str, set[str]] = {"server": set(), "client": set()}
+
+    for ctx in ctxs:
+        for reg, knob, node in _attr_refs(ctx):
+            referenced[reg].add(knob)
+            if knob not in decls[reg]:
+                findings.append(Finding(
+                    ctx.path, node.lineno, "knob-undeclared",
+                    f"{('SERVER' if reg == 'server' else 'CLIENT')}_KNOBS."
+                    f"{knob} has no init(\"{knob}\", ...) declaration in "
+                    "core/knobs.py — AttributeError on first read",
+                    end_line=node.end_lineno or node.lineno))
+        for reg, knob, lineno in _randomization_entries(ctx):
+            referenced[reg].add(knob)
+            if knob not in decls[reg]:
+                findings.append(Finding(
+                    ctx.path, lineno, "knob-undeclared",
+                    f"randomization entry ({knob!r}, {reg!r}) names an "
+                    "undeclared knob — set_knob would raise mid-sim"))
+
+    # string references (set_knob("X"), "server:X" spec knobs, --knob_x)
+    all_knobs = {k for reg in decls.values() for k in reg}
+    string_refs: set[str] = set()
+    for ctx in ctxs:
+        if ctx.path in decl_files:
+            continue
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                up = node.value.upper()
+                for k in all_knobs:
+                    if k in up and re.search(rf"\b{re.escape(k)}\b", up):
+                        string_refs.add(k)
+
+    for reg in ("server", "client"):
+        for knob, lineno in sorted(decls[reg].items(), key=lambda kv: kv[1]):
+            if knob in referenced[reg] or knob in string_refs:
+                continue
+            path = next(iter(
+                c.path for c in ctxs
+                if c.path in decl_files and knob in c.source), None)
+            if path is None:
+                continue
+            findings.append(Finding(
+                path, lineno, "knob-dead",
+                f"knob {knob} is declared but referenced nowhere (no "
+                "attribute access, randomization entry, or string "
+                "reference) — remove it or wire it up"))
+    return findings
+
+
+def check(ctx: FileCtx) -> list[Finding]:
+    return []  # whole-tree pack
