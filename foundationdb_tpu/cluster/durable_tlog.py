@@ -166,6 +166,14 @@ class DurableTaggedTLog(TaggedTLog):
         self.version.set(max(top, init_version))
         self.durable.set(max(top, init_version))
         self.entry_durable = max(top, init_version)
+        # Coverage floor of this incarnation: replay rebuilt every entry
+        # the queue still held; anything below the first of them was
+        # popped by every tag. A wiped datadir recovers empty with floor
+        # 0 — the next epoch-end quorum truncation raises it to the
+        # recovery version, routing replicated tag cursors to the peers
+        # that still hold the lost window.
+        self.available_from = (self._entries[0][0] - 1 if self._entries
+                               else self.version.get())
         # Recovered per-tag pops guide future discards only — entries are
         # NEVER dropped here: a hosted tag whose POP record was lost to
         # the torn tail (or who never flushed) still needs its prefix, and

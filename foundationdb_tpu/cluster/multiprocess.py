@@ -228,6 +228,8 @@ def read_cluster_file(path: str) -> Optional[dict]:
 
 
 def _spec_kw(spec: dict) -> dict:
+    from .replication import policy_for_mode
+
     n_logs = spec.get("n_logs", 2)
     n_log_hosts = spec.get("n_log_hosts", 1)
     if n_log_hosts > n_logs:
@@ -239,10 +241,42 @@ def _spec_kw(spec: dict) -> dict:
             "log host must own at least one log (lower n_log_hosts or "
             "raise n_logs)"
         )
+    log_replication = spec.get("log_replication", "single")
+    factor = policy_for_mode(log_replication).num_replicas()
+    if factor > n_logs:
+        # Caught at parse rather than wedging recovery: push could never
+        # assemble a k-replica set per tag, so no commit would ever ack
+        # and every lock would keep computing an unsatisfiable quorum.
+        raise ValueError(
+            f"log_replication={log_replication!r} needs {factor} logs; "
+            f"spec has n_logs={n_logs} (raise n_logs or lower the mode)"
+        )
+    if spec.get("regions"):
+        topo = spec.get("topology") or {}
+        if int(topo.get("n_dcs", 1)) < 2:
+            raise ValueError(
+                "two-region spec needs topology.n_dcs >= 2 (the remote "
+                "log set lives in the second DC)"
+            )
+        if n_log_hosts < 2:
+            # A remote log set with no host of its own would silently
+            # co-locate both regions' logs in one failure domain — the
+            # exact loss the region config exists to rule out.
+            raise ValueError(
+                "two-region spec lacks a second DC's log hosts: set "
+                "n_log_hosts >= 2 so the remote set has its own failure "
+                "domain"
+            )
+        raise ValueError(
+            "two-region log shipping is a sim-tier feature today "
+            "(cluster kind recoverable_sharded + topology); deploy the "
+            "multiprocess tier single-region with k-way log_replication"
+        )
     return dict(
         n_storage=spec.get("n_storage", 4),
         n_logs=n_logs,
         n_log_hosts=n_log_hosts,
+        log_replication=log_replication,
         n_resolvers=spec.get("n_resolvers", 1),
         replication=spec.get("replication", "double"),
         shard_boundaries=[
@@ -327,6 +361,14 @@ class LogHost:
 
     async def _control(self, log, req):
         if isinstance(req, TLogPeekRequest):
+            if log.available_from > req.from_version:
+                # This log cannot cover the cursor: the window below
+                # available_from was wiped with a destroyed datadir (and
+                # recovered past by the lock quorum) or already popped.
+                # Reply NOW — parking would stall the replicated cursor's
+                # failover to a covering peer (log_system.TagView's gap
+                # contract over the wire).
+                return ([], self.durable_all(), log.available_from)
             # LONG POLL (ref: tLogPeekMessages blocks until messages
             # arrive, TLogServer.actor.cpp:903): the reply parks until the
             # tag has durable data, bounded so a vanished peer cannot leak
@@ -338,7 +380,7 @@ class LogHost:
             if entries is _LOST:
                 t.cancel()
                 entries = []
-            return (entries, self.durable_all())
+            return (entries, self.durable_all(), log.available_from)
         if isinstance(req, TLogPopRequest):
             log.pop_tag(req.tag, req.version)
             return None
@@ -429,36 +471,80 @@ class RemoteTagView:
     """The storage server's log handle over TCP: same duck type as
     TagView (peek/pop/quorum_durable). Peeks are LONG-POLL: the server
     parks the reply until the tag has data (bounded by its poll window),
-    so the idle cost is one parked request per tag, not a retry timer."""
+    so the idle cost is one parked request per tag, not a retry timer.
+
+    Under k-way log replication the view holds a control stream to EVERY
+    replica log of its tag (the replica set is DERIVED — the same
+    replica_set_for_tag both tiers route pushes by, so the cursor can
+    never look for its slice on a log the proxy never fed) and FAILS OVER
+    between them: a replica whose available_from is past the cursor (a
+    destroyed datadir recovered past it by the lock quorum) replies
+    immediately instead of parking, and the cursor moves on; when NO
+    replica covers the cursor the window was lost beyond the replication
+    budget (or popped) and the cursor jumps the gap via the least-gapped
+    replica (log_system.TagView's contract, over the wire)."""
 
     def __init__(self, transport, log_addrs: list[str], tag: int,
-                 n_logs: int, tracker: DurabilityTracker):
+                 n_logs: int, tracker: DurabilityTracker,
+                 log_replication: str = "single", topology=None):
+        from .log_system import log_replicas, replica_set_for_tag
+        from .replication import policy_for_mode
+
         self.tag = tag
-        i = tag % n_logs
-        self._host = log_owner(i, len(log_addrs))
-        self._ctrl = transport.remote_stream(
-            log_addrs[self._host], WLTOKEN_LOG_BASE + 2 * i + 1
+        policy = policy_for_mode(log_replication)
+        self._replica_ids = replica_set_for_tag(
+            tag % n_logs, log_replicas(n_logs, topology), policy
         )
+        self._hosts = [log_owner(i, len(log_addrs))
+                       for i in self._replica_ids]
+        self._ctrls = [
+            transport.remote_stream(log_addrs[h],
+                                    WLTOKEN_LOG_BASE + 2 * i + 1)
+            for i, h in zip(self._replica_ids, self._hosts)
+        ]
+        self._pref = 0  # serving replica (index into the replica set)
         self._tracker = tracker
 
     async def peek(self, from_version: int):
         loop = current_loop()
+        gaps: dict[int, int] = {}  # replica -> its available_from > cursor
         while True:
+            k = self._pref
             req = TLogPeekRequest(self.tag, from_version)
-            self._ctrl.send(req)
+            self._ctrls[k].send(req)
             try:
-                entries, durable_all = await req.reply.future
-            except BaseException:  # noqa: BLE001 — conn loss: re-pull
+                entries, durable_all, available_from = await req.reply.future
+            except BaseException:  # noqa: BLE001 — conn loss: the host may
+                # be down; a covering replica on another host can serve.
                 await loop.delay(0.2)
+                self._pref = (self._pref + 1) % len(self._ctrls)
                 continue
-            self._tracker.feed(self._host, durable_all)
+            self._tracker.feed(self._hosts[k], durable_all)
             if entries:
                 return entries
+            if available_from > from_version:
+                gaps[k] = available_from
+                if len(gaps) == len(self._ctrls):
+                    # No replica covers the cursor: jump the gap from the
+                    # least-gapped copy (same shape as a purged-version
+                    # skip; entries carry their versions, so the storage
+                    # cursor follows).
+                    best = min(gaps, key=lambda i: (gaps[i], i))
+                    self._pref = best
+                    from_version = gaps[best]
+                    gaps = {}
+                    continue
+                self._pref = (self._pref + 1) % len(self._ctrls)
+                continue
             # Empty reply == the server's long-poll window elapsed with no
             # data for this tag: re-arm immediately (no client timer).
+            gaps.pop(k, None)
 
     def pop(self, upto_version: int) -> None:
-        self._ctrl.send(TLogPopRequest(self.tag, upto_version))
+        # Every replica holds this tag's slice: all must learn the pop or
+        # the non-serving copies would retain their prefixes forever.
+        for ctrl in self._ctrls:
+            ctrl.send(TLogPopRequest(self.tag, upto_version))
 
     def quorum_durable(self) -> int:
         return self._tracker.system_durable()
@@ -486,7 +572,9 @@ class StorageHost:
         self.durability.start_polling(self._tasks)
         for tag in range(kw["n_storage"]):
             view = RemoteTagView(transport, log_addrs, tag, kw["n_logs"],
-                                 self.durability)
+                                 self.durability,
+                                 log_replication=kw["log_replication"],
+                                 topology=kw["topology"])
             eng = _make_engine(spec.get("engine", "memory"),
                                f"{datadir}/storage{tag}")
             s = StorageServer(view, 0, tag=tag, engine=eng)
@@ -675,13 +763,28 @@ class RemoteLogSystem:
     """The proxy/recovery-side view of the log quorum over TCP: push fans
     one TLogCommitRequest per log (every log gets every version), lock /
     truncate / skip are awaited control RPCs (ref: push :339 + epochEnd
-    :107 of TagPartitionedLogSystem, with the RPC hop made explicit)."""
+    :107 of TagPartitionedLogSystem, with the RPC hop made explicit).
 
-    def __init__(self, transport, log_addrs, n_logs: int):
+    Routing rides the SAME replica_set_for_tag/route_batches the
+    in-process tier pushes by (derived from the shared deployment spec),
+    so a tag's mutations land on the same k policy-distinct logs no
+    matter which tier computed the fan-out, and the epoch-end recovery
+    version is the same k-1-excludable quorum order statistic."""
+
+    def __init__(self, transport, log_addrs, n_logs: int,
+                 log_replication: str = "single", topology=None):
+        from .log_system import log_replicas
+        from .replication import policy_for_mode
+
         if isinstance(log_addrs, str):  # single-host convenience
             log_addrs = [log_addrs]
         assert len(log_addrs) <= n_logs, "more log hosts than logs"
         self.n_logs = n_logs
+        self.log_replication = log_replication
+        self.policy = policy_for_mode(log_replication)
+        self.rep_factor = self.policy.num_replicas()
+        self.replicas = log_replicas(n_logs, topology)
+        self._tag_sets: dict[int, tuple[int, ...]] = {}
         addr_of = lambda i: log_addrs[log_owner(i, len(log_addrs))]
         self._commit = [
             transport.remote_stream(addr_of(i), WLTOKEN_LOG_BASE + 2 * i)
@@ -694,12 +797,22 @@ class RemoteLogSystem:
         self._durable_cache = 0
         self._queue_bytes_cache = 0
 
+    def replica_set_for_tag(self, tag: int) -> tuple[int, ...]:
+        from .log_system import replica_set_for_tag
+
+        key = tag % len(self.replicas)
+        cached = self._tag_sets.get(key)
+        if cached is None:
+            cached = replica_set_for_tag(key, self.replicas, self.policy)
+            self._tag_sets[key] = cached
+        return cached
+
     async def push(self, prev_version: int, version: int,
                    tagged_mutations, epoch: int = 0) -> None:
-        per_log: list[list] = [[] for _ in range(self.n_logs)]
-        for tm in tagged_mutations:
-            for i in sorted({t % self.n_logs for t in tm.tags}):
-                per_log[i].append(tm)
+        from .log_system import route_batches
+
+        per_log = route_batches(tagged_mutations, self.n_logs,
+                                self.replica_set_for_tag)
         reqs = []
         for stream, batch in zip(self._commit, per_log):
             req = TLogCommitRequest(prev_version, version, tuple(batch),
@@ -729,9 +842,14 @@ class RemoteLogSystem:
 
     async def lock(self, epoch: int) -> tuple[int, int]:
         """Returns (recovery_version, max received version) after fencing
-        and QUORUM-TRUNCATING every log."""
+        and QUORUM-TRUNCATING every log. Under k-way replication the k-1
+        worst durable cursors are excludable (a destroyed log datadir
+        recovers at 0 and loses nothing acked — every acked commit waited
+        the FULL fsync quorum, so it is durable on every log that kept
+        its state; see TagPartitionedLogSystem.lock)."""
         results = await self._control_all(lambda: TLogLockRequest(epoch))
-        recovery_version = min(d for d, _v in results)
+        budget = min(self.rep_factor - 1, self.n_logs - 1)
+        recovery_version = sorted(d for d, _v in results)[budget]
         received = max(v for _d, v in results)
         await self._control_all(
             lambda: TLogTruncateRequest(recovery_version)
@@ -742,18 +860,41 @@ class RemoteLogSystem:
         await self._control_all(lambda: TLogSkipToRequest(version))
 
     async def confirm_epoch_live(self, epoch: int) -> None:
-        """(ref: confirmEpochLive :553.) Raises unless EVERY log of the
-        quorum answers and none is locked by a newer generation; an
-        unreachable log host means liveness cannot be proven and the GRV
-        must stall rather than risk a stale read."""
+        """(ref: confirmEpochLive :553.) Under k-way replication a
+        successor recovers from any n-(k-1) logs, so liveness needs
+        confirmation from at least n-(k-1) UNLOCKED logs — any set that
+        large intersects every possible successor quorum. A log fenced by
+        a newer generation fails the probe outright; fewer than n-(k-1)
+        answers (unreachable hosts) means a successor's quorum cannot be
+        ruled out and the GRV must stall rather than risk a stale read."""
         from ..core.errors import TLogStopped
 
-        results = await self._control_all(lambda: TLogConfirmEpochRequest())
-        for locked in results:
+        reqs = []
+        for stream in self._ctrl:
+            req = TLogConfirmEpochRequest()
+            stream.send(req)
+            reqs.append(req)
+        await timeout(
+            all_of([r.reply.future for r in reqs]),
+            SERVER_KNOBS.ROLE_RPC_TIMEOUT, _LOST,
+        )
+        confirms = 0
+        for r in reqs:
+            if not r.reply.future.is_ready():
+                continue  # dark host: proves nothing either way
+            locked = r.reply.future.get()
             if locked > epoch:
                 raise TLogStopped(
                     f"epoch {epoch} fenced by generation {locked}"
                 )
+            confirms += 1
+        need = self.n_logs - (self.rep_factor - 1)
+        if confirms < need:
+            raise OperationFailed(
+                f"confirmEpochLive: only {confirms}/{self.n_logs} logs "
+                f"answered (need {need}); a successor's quorum cannot be "
+                "ruled out"
+            )
 
     async def refresh_status(self) -> None:
         results = await self._control_all(lambda: TLogStatusRequest())
@@ -833,7 +974,10 @@ class TxnHost:
             transport.remote_stream(resolver_addr, WLTOKEN_RESOLVER_BASE)
             if resolver_addr is not None else None
         )
-        self.log_system = RemoteLogSystem(transport, log_addrs, self.n_logs)
+        self.log_system = RemoteLogSystem(
+            transport, log_addrs, self.n_logs,
+            log_replication=kw["log_replication"], topology=kw["topology"],
+        )
         self.storage_ctrl = {
             tag: transport.remote_stream(
                 storage_addr, WLTOKEN_STORAGE_BASE + 2 * tag + 1
@@ -1117,6 +1261,8 @@ class TxnHost:
                 for r in resolvers:
                     await r.refresh_status()
                 self.balancer.step(self.master.version)
+            except GeneratorExit:
+                raise
             except BaseException as e:  # noqa: BLE001 — transient RPC loss
                 from ..core.errors import ActorCancelled
 
@@ -1132,6 +1278,8 @@ class TxnHost:
                 await self.log_system.refresh_status()
                 for st in storage_statuses:
                     await st.refresh()
+            except GeneratorExit:
+                raise
             except BaseException:  # noqa: BLE001 — transient RPC loss
                 pass
             await loop.delay(SERVER_KNOBS.RATEKEEPER_UPDATE_INTERVAL)
@@ -1176,7 +1324,7 @@ class TxnHost:
                             "Generation", self.generation
                         ).log()
                         await self.recover()
-                except ActorCancelled:
+                except (ActorCancelled, GeneratorExit):
                     raise
                 except BaseException as e:  # noqa: BLE001
                     TraceEvent("ControllerError", severity=30).error(e).log()

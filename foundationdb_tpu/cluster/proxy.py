@@ -280,13 +280,19 @@ class CommitProxy:
             # resolve_batch's own failure path) and the tlog's, via an
             # empty batch for this window (tlog.commit is idempotent per
             # window, so a failure after logging is safe too).
-            from ..core.errors import CommitUnknownResult, RequestMaybeDelivered
+            from ..core.errors import (
+                CommitUnknownResult,
+                RequestMaybeDelivered,
+                TLogFailed,
+            )
 
             # An epoch fence is EXPECTED during recovery, and a lost role
-            # RPC is environmental (severity 30); anything else is a real
+            # RPC or an unreachable log quorum (a dark machine under k-way
+            # replication: the push must stall, not shed a copy) is
+            # environmental (severity 30); anything else is a real
             # failure (severity 40).
             fenced = isinstance(e, TLogStopped)
-            lost_rpc = isinstance(e, RequestMaybeDelivered)
+            lost_rpc = isinstance(e, (RequestMaybeDelivered, TLogFailed))
             TraceEvent("ProxyCommitBatchError",
                        severity=30 if (fenced or lost_rpc) else 40
                        ).error(e).log()

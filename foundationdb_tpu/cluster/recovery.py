@@ -270,7 +270,7 @@ class RecoverableCluster:
                             "Name", name
                         ).detail("Generation", self.generation).log()
                         self._recover()
-                except ActorCancelled:
+                except (ActorCancelled, GeneratorExit):
                     raise
                 except BaseException as e:  # noqa: BLE001
                     TraceEvent("ControllerError", severity=30).error(e).log()
@@ -399,6 +399,10 @@ class RecoverableShardedCluster:
         self.inner._started = True
         for s in self.inner.storages:
             s.start()
+        # Log routers (two-region shipping) outlive generations: the
+        # direction check rides the log system's active_set, so they go
+        # dormant by themselves after a failover.
+        self.inner._router_tasks = self.inner._spawn_log_routers()
         self._recover()
         return self
 
@@ -407,13 +411,16 @@ class RecoverableShardedCluster:
         self._stop_transaction_system()
         if self.inner.dd is not None:
             self.inner.dd.stop()
+        for t in self.inner._router_tasks:
+            t.cancel()
+        self.inner._router_tasks = []
         for s in self.inner.storages:
             s.stop()
         if self.inner.datadir is not None:
             from .sharded_cluster import close_durable_tier
 
             close_durable_tier(self.inner.storages,
-                               self.inner.log_system.logs)
+                               self.inner.log_system.all_logs())
 
     def database(self):
         from ..client.connection import ShardedConnection

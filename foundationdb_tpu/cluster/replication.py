@@ -206,6 +206,15 @@ def policy_for_mode(mode: str) -> ReplicationPolicy:
         return PolicyAcross(2, "zoneid", PolicyOne())
     if mode == "triple":
         return PolicyAcross(3, "zoneid", PolicyOne())
+    if mode == "two_datacenter":
+        # The two-region layout's team mode: every team spans both DCs
+        # (so a whole-datacenter loss leaves a serving replica while the
+        # log tier fails over to the remote log set). The reference
+        # expresses its region configs with the same Across-dcid tree.
+        return PolicyAnd(
+            PolicyAcross(2, "dcid", PolicyOne()),
+            PolicyAcross(2, "zoneid", PolicyOne()),
+        )
     if mode == "three_datacenter":
         return PolicyAnd(
             PolicyAcross(3, "dcid", PolicyOne()),

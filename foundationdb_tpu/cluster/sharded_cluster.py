@@ -52,8 +52,29 @@ class ShardedKVCluster:
         resolver_boundaries: Optional[Sequence[bytes]] = None,
         topology: Optional[dict] = None,
         os_layer=None,
+        log_replication: str = "single",
+        regions: bool = False,
     ):
         self.policy = policy_for_mode(replication)
+        # Log replication is configured SEPARATELY from storage-team
+        # replication (the reference's log_replicas vs storage_replicas):
+        # k-way mutation copies across the log fleet's failure domains,
+        # with the epoch-end recovery version computed from a quorum.
+        log_rep_factor = policy_for_mode(log_replication).num_replicas()
+        if log_rep_factor > n_logs:
+            raise ValueError(
+                f"log_replication={log_replication!r} needs "
+                f"{log_rep_factor} logs; spec has n_logs={n_logs}"
+            )
+        self.log_replication = log_replication
+        self.regions = bool(regions)
+        if self.regions and (
+            topology is None or int(topology.get("n_dcs", 1)) < 2
+        ):
+            raise ValueError(
+                "regions=True needs a machine topology with n_dcs >= 2 "
+                "(the remote log set lives in the second DC)"
+            )
         # `topology` ({"n_dcs", "machines_per_dc"}) switches localities to
         # the machine/DC model (sim/topology.py): zone == machine, so the
         # replication policy places each team across distinct MACHINES and
@@ -78,6 +99,9 @@ class ShardedKVCluster:
             log_factory = lambda i: DurableTaggedTLog(  # noqa: E731
                 f"{datadir}/log{i}", os_layer=os_layer
             )
+            remote_log_factory = lambda i: DurableTaggedTLog(  # noqa: E731
+                f"{datadir}/rlog{i}", os_layer=os_layer
+            )
             engines = [
                 _make_engine(engine, f"{datadir}/storage{i}",
                              os_layer=os_layer)
@@ -85,10 +109,15 @@ class ShardedKVCluster:
             ]
         else:
             log_factory = None
+            remote_log_factory = None
             engines = [None] * n_storage
         self.log_system = TagPartitionedLogSystem(
-            n_logs, log_factory=log_factory
+            n_logs, log_factory=log_factory,
+            log_replication=log_replication, topology=topology,
+            regions=self.regions, remote_log_factory=remote_log_factory,
         )
+        self.log_routers: list = []
+        self._router_tasks: list = []
         self.storages = [
             StorageServer(self.log_system.tag_view(i), 0, tag=i,
                           engine=engines[i])
@@ -178,7 +207,7 @@ class ShardedKVCluster:
         # only RecoverableShardedCluster runs on boot.
         if self.datadir is not None and any(
             log.version.get() > 0 or log.locked_epoch > 0
-            for log in self.log_system.logs
+            for log in self.log_system.all_logs()
         ):
             raise ValueError(
                 "datadir holds recovered log state; reopen it with "
@@ -195,7 +224,26 @@ class ShardedKVCluster:
             self._balancer_task = self._start_balancer(
                 self.resolver_config, self.resolvers
             )
+        self._router_tasks = self._spawn_log_routers()
         return self
+
+    def _spawn_log_routers(self) -> list:
+        """One LogRouter per primary log when a remote set is configured
+        (ref: LogRouter.actor.cpp — the remote DC pulls, the commit path
+        never waits on it)."""
+        from ..core.runtime import TaskPriority, spawn
+        from .log_system import LogRouter
+
+        if len(self.log_system.log_sets) < 2:
+            return []
+        self.log_routers = [
+            LogRouter(self.log_system, i)
+            for i in range(len(self.log_system.log_sets[0]))
+        ]
+        return [
+            spawn(r.run(), TaskPriority.TLOG_COMMIT, name=f"logRouter{i}")
+            for i, r in enumerate(self.log_routers)
+        ]
 
     def _start_balancer(self, config, resolvers):
         """resolutionBalancing's control loop (ref:
@@ -261,13 +309,16 @@ class ShardedKVCluster:
             self.dd.stop()
         if self._balancer_task is not None:
             self._balancer_task.cancel()
+        for t in self._router_tasks:
+            t.cancel()
+        self._router_tasks = []
         for p in self.proxies:
             p.stop()
         self.ratekeeper.stop()
         for s in self.storages:
             s.stop()
         if self.datadir is not None:
-            close_durable_tier(self.storages, self.log_system.logs)
+            close_durable_tier(self.storages, self.log_system.all_logs())
         self._started = False
 
     def database(self):

@@ -69,15 +69,26 @@ def _sharded_status(cluster) -> dict[str, Any]:
             "txns_too_old": proxy.txns_too_old,
         },
     ]
-    for i, log in enumerate(ls.logs):
-        roles.append({
-            "role": "log",
-            "id": i,
-            "version": log.version.get(),
-            "durable_version": log.durable.get(),
-            "queue_entries": len(log._entries)
-            + getattr(log, "spilled_entries", 0),
-        })
+    # Per-log-set roles: the serving set plus (two-region clusters) the
+    # remote set, each log with its durable-version LAG behind the
+    # highest version the set has received — the number an operator
+    # watches to see a wiped/behind replica catching back up.
+    log_sets = getattr(ls, "log_sets", None) or [ls.logs]
+    for set_idx, log_set in enumerate(log_sets):
+        set_top = max((log.version.get() for log in log_set), default=0)
+        for i, log in enumerate(log_set):
+            roles.append({
+                "role": "log",
+                "id": i,
+                "log_set": set_idx,
+                "serving": set_idx == getattr(ls, "active_set", 0),
+                "version": log.version.get(),
+                "durable_version": log.durable.get(),
+                "durable_lag_versions": set_top - log.quorum_durable(),
+                "reachable": getattr(log, "reachable", True),
+                "queue_entries": len(log._entries)
+                + getattr(log, "spilled_entries", 0),
+            })
     durable = ls.durable_version()
     for s in cluster.storages:
         roles.append({
@@ -114,6 +125,12 @@ def _sharded_status(cluster) -> dict[str, Any]:
         "configuration": {
             "redundancy_mode": cluster.policy.describe(),
             "logs": len(ls.logs),
+            # k-way log replication (per log set): mode + the policy's
+            # replica count, so `status json` shows what a destroyed
+            # datadir is allowed to cost (nothing, for k >= 2).
+            "log_replication": getattr(ls, "log_replication", "single"),
+            "log_replication_factor": getattr(ls, "rep_factor", 1),
+            "regions": len(log_sets) > 1,
             "storage_servers": len(cluster.storages),
             "values": dict(cluster.config_values),
             "excluded_servers": sorted(cluster.excluded),
@@ -122,6 +139,25 @@ def _sharded_status(cluster) -> dict[str, Any]:
         "shards": shards,
         "roles": roles,
     })
+    if len(log_sets) > 1:
+        # Remote-DC shipping observability: how far the LogRouters'
+        # shipped floor trails what committers have been acked — the
+        # failover gate (lock refuses to fail over while lag > 0, or an
+        # acked write would be stranded on the dark primary).
+        shipped = ls.shipped_version()
+        st["cluster"]["regions"] = {
+            "failed_over": bool(getattr(ls, "failed_over", False)),
+            "active_set": getattr(ls, "active_set", 0),
+            "shipped_version": shipped,
+            "remote_pull_lag_versions": max(
+                0, getattr(ls, "_acked_floor", 0) - shipped
+            ),
+            "routers": [
+                {"index": r.index, "shipped": r.shipped,
+                 "batches_shipped": r.batches_shipped}
+                for r in getattr(cluster, "log_routers", [])
+            ],
+        }
     return st
 
 

@@ -27,6 +27,15 @@ class MemoryTLog:
         self.durable = NotifiedVersion(init_version)   # highest "fsynced"
         self.popped = init_version
         self.locked_epoch = 0
+        # Versions <= available_from cannot be served by THIS log: they
+        # were popped, or lost with a destroyed/behind incarnation and
+        # recovered past by the lock quorum. Replicated tag cursors fail
+        # over to a covering replica (log_system.TagView).
+        self.available_from = init_version
+        # Cleared while the hosting machine/process is dark (sim fault
+        # topology flips it); a dark log can neither join the fsync
+        # quorum nor serve peeks.
+        self.reachable = True
 
     def lock(self, epoch: int) -> int:
         """Epoch end (ref: TagPartitionedLogSystem::epochEnd :107): fence
@@ -140,6 +149,7 @@ class MemoryTLog:
             return
         self.popped = upto_version
         self._entries = [e for e in self._entries if e[0] > upto_version]
+        self.available_from = max(self.available_from, upto_version)
 
     def skip_to(self, version: int) -> None:
         """Recovery gap-skip: advance the (received, durable) cursors to
@@ -156,10 +166,17 @@ class MemoryTLog:
 
     def truncate_above(self, version: int) -> None:
         """Epoch-end quorum truncation: discard entries above the recovery
-        version the full log quorum agreed on (ref: epochEnd — a commit
-        durable on a subset of logs never completed). The durable tier
-        overrides this to persist the truncation."""
+        version the log QUORUM agreed on (ref: epochEnd — a commit whose
+        fsync quorum never completed never happened). Under k-way
+        replication the quorum version may exceed THIS log's durable top
+        (this log is one of the excludable k-1 worst); the missing window
+        is marked unavailable so replicated tag cursors fail over to the
+        peers that durably hold it. The durable tier overrides this to
+        persist the truncation."""
+        top = self._entries[-1][0] if self._entries else self.popped
         self._entries = [e for e in self._entries if e[0] <= version]
+        if top < version:
+            self.available_from = max(self.available_from, version)
 
     def quorum_durable(self) -> int:
         """The version durable across the WHOLE log quorum this log is part

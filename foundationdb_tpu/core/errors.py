@@ -73,6 +73,7 @@ WorkerRemoved = _define("WorkerRemoved", 1202, "Normal worker shut down")
 PlatformError = _define("PlatformError", 1500, "Platform error")
 IoError = _define("IoError", 1510, "Disk i/o operation failed")
 TLogStopped = _define("TLogStopped", 1011, "TLog stopped (locked by a newer recovery generation)")
+TLogFailed = _define("TLogFailed", 1205, "Transaction log unreachable (the commit's fsync quorum cannot be formed)")
 EndOfStream = _define("EndOfStream", 1, "End of stream")
 
 RETRYABLE_CODES = frozenset(

@@ -113,6 +113,15 @@ class ServerKnobs(Knobs):
         # Continuous backup: delay before the ship actor retries after a
         # container/peek failure (backup.ContinuousBackupAgent._ship).
         init("BACKUP_SHIP_RETRY_INTERVAL", 0.5, sim_random_range=(0.05, 1.0))
+        # k-way log push (log_system.push): how often a single replica's
+        # transiently-errored append is retried back into the fsync
+        # quorum before the whole batch fails (the log_push_drop buggify
+        # exercises this path), and the backoff between attempts.
+        init("LOG_PUSH_RETRIES", 3, sim_random_range=(1, 4))
+        init("LOG_PUSH_RETRY_DELAY", 0.05, sim_random_range=(0.01, 0.2))
+        # Two-DC log shipping (log_system.LogRouter): backoff when the
+        # source/destination log is dark or fenced mid-ship.
+        init("LOG_ROUTER_RETRY_INTERVAL", 0.1, sim_random_range=(0.02, 0.5))
         # Failure monitoring (ref: fdbserver/Knobs.cpp failure monitor)
         init("FAILURE_MIN_DELAY", 2.0)
         init("FAILURE_TIMEOUT_DELAY", 1.0)

@@ -184,7 +184,7 @@ class Promise:
 class Task:
     """A running actor: a coroutine plus its completion future."""
 
-    __slots__ = ("coro", "done", "priority", "loop", "_waiting_on", "_resume_cb", "_cancelled", "name")
+    __slots__ = ("coro", "done", "priority", "loop", "_waiting_on", "_resume_cb", "_cancelled", "name", "tid")
 
     def __init__(self, coro: Coroutine, priority: int, loop: "EventLoop", name: str = ""):
         self.coro = coro
@@ -192,6 +192,7 @@ class Task:
         self.priority = priority
         self.loop = loop
         self.name = name or coro.__qualname__
+        self.tid = 0  # registry key, assigned by EventLoop.spawn
         self._waiting_on: Optional[Future] = None
         self._resume_cb = None
         self._cancelled = False
@@ -232,6 +233,7 @@ class Task:
             # observes the ready future and no-ops.
             self.coro.close()
             self.done._send_error(ActorCancelled())
+            loop._live_tasks.pop(self.tid, None)
         # Otherwise: currently on the ready queue mid-execution; the
         # pending step will observe _cancelled and throw into the
         # coroutine.
@@ -307,6 +309,15 @@ class EventLoop:
         self.buggify_on = False
         self.tasks_run = 0
         self.current_task: Optional[Task] = None
+        # Every live (spawned, not yet completed) task, in spawn order.
+        # shutdown() closes the leftovers DETERMINISTICALLY at end of run;
+        # without this, suspended coroutines of a finished loop sit in GC
+        # cycles (task <-> resume-callback <-> future) until the cycle
+        # collector fires MID-way through a LATER simulation run, and
+        # their close paths (exception handlers, finallys) execute at a
+        # GC-chosen instant — observed as same-seed chaos specs diverging
+        # under pytest but not standalone.
+        self._live_tasks: dict[int, Task] = {}
         # Optional I/O reactor (real-clock loops only): polled when the
         # loop would otherwise sleep, so socket readiness wakes actors
         # (ref: ASIOReactor::sleepAndReact, flow/Net2.actor.cpp:948).
@@ -338,6 +349,9 @@ class EventLoop:
     # -- actors --
     def spawn(self, coro: Coroutine, priority: int = TaskPriority.DEFAULT, name: str = "") -> Task:
         task = Task(coro, priority, self, name)
+        self._seq += 1
+        task.tid = self._seq
+        self._live_tasks[task.tid] = task
         self._schedule_step(task, None, None)
         return task
 
@@ -364,10 +378,13 @@ class EventLoop:
                 fut = task.coro.send(value)
         except StopIteration as e:
             task.done._send(e.value)
+            self._live_tasks.pop(task.tid, None)
         except ActorCancelled as e:
             task.done._send_error(e)
+            self._live_tasks.pop(task.tid, None)
         except BaseException as e:  # noqa: BLE001 — errors propagate via the future
             task.done._send_error(e)
+            self._live_tasks.pop(task.tid, None)
         else:
             if not isinstance(fut, Future):
                 raise TypeError(f"actor {task.name} awaited non-Future {fut!r}")
@@ -387,6 +404,34 @@ class EventLoop:
     # -- running --
     def stop(self) -> None:
         self._stopped = True
+
+    def shutdown(self) -> None:
+        """Deterministically close every task still live after a run.
+
+        A finished simulation leaves suspended coroutines behind (parked
+        controllers, long-poll peeks, retry loops); if they linger, the GC
+        cycle collector closes them at an arbitrary later instant —
+        possibly inside a DIFFERENT loop's run, where a close path that
+        runs handler code (or emits TraceEvents) breaks that run's
+        seed-determinism. Closing them here, in spawn order and with THIS
+        loop current, pins all of that to one reproducible point.
+        Idempotent; the loop must not be run again afterwards."""
+        self._stopped = True
+        with loop_context(self):
+            while self._live_tasks:
+                tid = next(iter(self._live_tasks))
+                task = self._live_tasks.pop(tid)
+                try:
+                    task.coro.close()
+                except BaseException:  # noqa: BLE001 — a handler that
+                    # swallows GeneratorExit raises RuntimeError here; the
+                    # coroutine is dead regardless and must not block the
+                    # rest of the drain.
+                    pass
+                if not task.done.is_ready():
+                    task.done._send_error(ActorCancelled())
+        self._ready.clear()
+        self._timers.clear()
 
     # Steps allowed at one virtual instant before declaring a livelock: a
     # `while True: await delay(0)` actor never advances SimClock, so the

@@ -86,7 +86,13 @@ def run_simulation(path: str) -> int:
     return 0 if result.get("ok") and result.get("sev_errors", 0) == 0 else 1
 
 
-def run_fdbd(sharded: bool) -> int:
+def _mode_replicas(mode: str) -> int:
+    from .cluster.replication import policy_for_mode
+
+    return policy_for_mode(mode).num_replicas()
+
+
+def run_fdbd(sharded: bool, log_replication: str = "single") -> int:
     from .core.runtime import EventLoop, loop_context
 
     loop = EventLoop()
@@ -94,7 +100,10 @@ def run_fdbd(sharded: bool) -> int:
         if sharded:
             from .cluster.sharded_cluster import ShardedKVCluster
 
-            cluster = ShardedKVCluster().start()
+            cluster = ShardedKVCluster(
+                log_replication=log_replication,
+                n_logs=max(2, _mode_replicas(log_replication)),
+            ).start()
         else:
             from .cluster.cluster import LocalCluster
 
@@ -147,6 +156,11 @@ def main(argv=None) -> int:
     ap.add_argument("-f", "--testfile", help="spec file for -r simulation")
     ap.add_argument("--sharded", action="store_true",
                     help="fdbd: start the sharded/replicated tier")
+    ap.add_argument("--log-replication", default="single",
+                    choices=["single", "double", "triple"],
+                    help="fdbd --sharded: k-way log replication mode "
+                         "(multi-process deployments set the spec's "
+                         "log_replication key instead)")
     ap.add_argument("-c", "--class", dest="process_class",
                     help="fdbd: host ONE role class of a multi-process "
                          "cluster: log / logN (one failure domain of an "
@@ -173,7 +187,10 @@ def main(argv=None) -> int:
         if not args.cluster_file or not args.datadir:
             ap.error("--class requires --cluster-file and --datadir")
         return run_role_host(args)
-    return run_fdbd(args.sharded)
+    if args.log_replication != "single" and not args.sharded:
+        ap.error("--log-replication requires --sharded (the one-process "
+                 "cluster has a single log)")
+    return run_fdbd(args.sharded, log_replication=args.log_replication)
 
 
 if __name__ == "__main__":

@@ -53,6 +53,13 @@ _KNOB_RANGES = [
     # fallback mid-workload, the shape-churn path a fixed default never
     # exercises.
     ("TPU_MAX_TOUCHED_BLOCKS", "server", (8, 64)),
+    # k-way log push retry/backoff + the two-DC log router's dark-peer
+    # backoff (log_system.push / LogRouter.run): perturbed so the
+    # log_push_drop buggify's retry path and router stalls are exercised
+    # at different cadences.
+    ("LOG_PUSH_RETRIES", "server", (1, 4)),
+    ("LOG_PUSH_RETRY_DELAY", "server", (0.01, 0.2)),
+    ("LOG_ROUTER_RETRY_INTERVAL", "server", (0.02, 0.5)),
 ]
 
 # Categorical knob draws (same subset-randomization policy as the ranges).
@@ -90,6 +97,34 @@ def generate_config(seed: int) -> dict[str, Any]:
         while n_dcs * machines_per_dc < need:
             machines_per_dc += 1
         topology = {"n_dcs": n_dcs, "machines_per_dc": machines_per_dc}
+
+    # Two-region log shipping (log_system.LogRouter): a remote log set in
+    # DC1 fed asynchronously, with recovery failing over to it after a
+    # primary-DC loss. Needs >= 2 DCs; storage teams switch to the
+    # DC-spanning mode so a whole-DC kill stays inside what the team
+    # policy survives (and the MachineAttrition dc_kill draw can land).
+    regions = False
+    if topology is not None and topology["n_dcs"] >= 2 \
+            and rng.random() < 0.4:
+        regions = True
+        replication = "two_datacenter"
+
+    # k-way log replication, constrained by how many distinct failure
+    # domains actually host logs: without a machine topology every log
+    # has its own zone; with one, logs collapse onto machines (DC0's
+    # machines only, under regions) and the policy needs k distinct.
+    if topology is None:
+        log_domains = n_logs
+    elif regions:
+        log_domains = min(n_logs, topology["machines_per_dc"])
+    else:
+        log_domains = min(
+            n_logs, topology["n_dcs"] * topology["machines_per_dc"]
+        )
+    log_modes = [m for m, k in
+                 (("single", 1), ("double", 2), ("triple", 3))
+                 if k <= log_domains]
+    log_replication = rng.choice(log_modes)
 
     knobs: dict[str, Any] = {}
     for name, reg, (lo, hi) in _KNOB_RANGES:
@@ -166,6 +201,10 @@ def generate_config(seed: int) -> dict[str, Any]:
         "n_logs": n_logs,
         "replication": replication,
     }
+    if log_replication != "single":
+        cluster["log_replication"] = log_replication
+    if regions:
+        cluster["regions"] = True
     if topology is not None:
         cluster["topology"] = topology
     return {

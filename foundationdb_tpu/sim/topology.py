@@ -39,10 +39,15 @@ analogue of sim2's protectedAddresses, which kills must route around.
 
 In-process limits (documented, not hidden): role-to-role traffic does
 not cross the SimNetwork (the reference's intra-machine traffic is
-near-free too), so clogs and swizzles act on the client<->cluster hops;
-and a killed tlog keeps its in-memory state (kill == blackout), because
-full log-server loss is the log-replication tier's subject — see
-sim/network.py's module docstring for the same contract.
+near-free too), so clogs and swizzles act on the client<->cluster hops.
+A killed tlog keeps its in-memory state but goes DARK (reachable=False):
+it can neither join the fsync quorum nor serve peeks, so under k-way
+log replication the epoch-end quorum excludes it (k-1 budget) and a
+primary-DC blackout arms the two-region failover; only when the dark
+set exceeds what the mode covers does lock() fall back to the
+in-process blackout shortcut (state addressable, trace-logged). True
+STATE loss is exercised by the power-loss reboots here and the
+destroyed-datadir tests of the log-replication tier.
 """
 
 from __future__ import annotations
@@ -67,6 +72,9 @@ class SimMachine:
         self.proc = SimProcess(self.name)
         self.storage_tags: list[int] = []
         self.log_ids: list[int] = []
+        # Remote (second-DC) log set indices, two-region clusters only:
+        # fed by the LogRouters, never on the commit path until failover.
+        self.remote_log_ids: list[int] = []
         self.has_txn = False
         self.coordinator_ids: list[int] = []
         self.alive = True
@@ -172,8 +180,23 @@ class MachineTopology:
         # -- role placement (must mirror build_replicas for storages) --
         for t in range(len(cluster.storages)):
             self.machines[t % n_machines].storage_tags.append(t)
-        for i in range(len(cluster.log_system.logs)):
-            self.machines[i % n_machines].log_ids.append(i)
+        # Log placement mirrors log_system.log_replicas' homes exactly —
+        # the policy spread the replicas across THESE machines, so a
+        # machine kill takes out precisely the replicas placed on it.
+        # Two-region clusters confine the primary set to DC0's machines
+        # and the remote set to DC1's (log_replicas with dc=0/1).
+        log_sets = getattr(cluster.log_system, "log_sets", None)
+        regions = log_sets is not None and len(log_sets) > 1
+        if regions:
+            for d, attr in ((0, "log_ids"), (1, "remote_log_ids")):
+                dc_machines = [m for m in range(n_machines)
+                               if m % self.n_dcs == d]
+                for i in range(len(log_sets[d])):
+                    getattr(self.machines[dc_machines[i % len(dc_machines)]],
+                            attr).append(i)
+        else:
+            for i in range(len(cluster.log_system.logs)):
+                self.machines[i % n_machines].log_ids.append(i)
         # Coordinators on the LAST machine of each DC (wrapping): spread
         # across failure domains, away from the low-index machines that
         # host the killable roles. Small fleets CO-LOCATE coordinators
@@ -315,6 +338,12 @@ class MachineTopology:
         self.net.blackout(m.proc)
         for t in m.storage_tags:
             self.cluster.storages[t].stop()
+        # Resident logs go DARK: they can neither join the fsync quorum
+        # (push stalls/fails rather than silently shedding a copy) nor
+        # serve peeks; under k-way replication the epoch-end quorum
+        # excludes them (log_system.lock's k-1 budget), and a primary-DC
+        # blackout is what arms the region failover.
+        self._set_logs_reachable(m, False)
         if m.has_txn or m.log_ids:
             # Co-resident transaction-system roles die with the machine —
             # the shared-fate instant per-role kills could never produce.
@@ -330,6 +359,16 @@ class MachineTopology:
             "Txn", m.has_txn
         ).log()
 
+    def _set_logs_reachable(self, m: SimMachine, up: bool) -> None:
+        log_sets = getattr(self.cluster.log_system, "log_sets", None)
+        if log_sets is None:
+            return
+        for i in m.log_ids:
+            log_sets[0][i].reachable = up
+        if len(log_sets) > 1:
+            for i in m.remote_log_ids:
+                log_sets[1][i].reachable = up
+
     def restore_machine(self, m: SimMachine) -> None:
         if m.alive:
             return
@@ -337,6 +376,7 @@ class MachineTopology:
         self.net.restore(m.proc)
         for t in m.storage_tags:
             self.cluster.storages[t].start()
+        self._set_logs_reachable(m, True)
         TraceEvent("SimMachineRestored").detail("Machine", m.name).log()
 
     async def reboot_machine(self, m: SimMachine, outage: float = 0.2,
@@ -361,6 +401,7 @@ class MachineTopology:
         datadir = cluster.datadir
         prefixes = [f"{datadir}/storage{t}" for t in m.storage_tags]
         prefixes += [f"{datadir}/log{i}" for i in m.log_ids]
+        prefixes += [f"{datadir}/rlog{i}" for i in m.remote_log_ids]
         stats = self.disk.kill(prefixes=prefixes)
         TraceEvent("SimPowerLoss", severity=30).detail(
             "Machine", m.name
@@ -372,14 +413,22 @@ class MachineTopology:
         from ..cluster.sharded_cluster import _make_engine
         from ..cluster.storage import StorageServer
 
-        for i in m.log_ids:
-            old = cluster.log_system.logs[i]
+        log_sets = cluster.log_system.log_sets
+        rebuilt = [(log_sets[0], i, f"{datadir}/log{i}")
+                   for i in m.log_ids]
+        if len(log_sets) > 1:
+            rebuilt += [(log_sets[1], i, f"{datadir}/rlog{i}")
+                        for i in m.remote_log_ids]
+        for log_set, i, prefix in rebuilt:
+            old = log_set[i]
             # stop (not close): close would flush through fds the disk
             # kill already invalidated; the dead incarnation just drops.
             old.stop()
-            cluster.log_system.logs[i] = DurableTaggedTLog(
-                f"{datadir}/log{i}", os_layer=self.disk
-            )
+            fresh = DurableTaggedTLog(prefix, os_layer=self.disk)
+            # The machine is still dark (restore_machine flips the NEW
+            # object back via log_sets).
+            fresh.reachable = False
+            log_set[i] = fresh
         for t in m.storage_tags:
             old = cluster.storages[t]  # already stopped by the kill
             engine = _make_engine(self.engine_kind,
@@ -397,6 +446,11 @@ class MachineTopology:
             fresh.owned = old.owned
             fresh.assigned = old.assigned
             cluster.storages[t] = fresh
+        # Rebuilt logs replay only the POP records the disk kept: re-pin
+        # every tag's discard floor or a lost pop record would let peers'
+        # future pops eat a behind tag's prefix.
+        if hasattr(cluster.log_system, "reregister_tags"):
+            cluster.log_system.reregister_tags()
         # The rebuilt tlog's durable top is wherever its last fsync
         # reached: fence + truncate the quorum to the new minimum before
         # anything trusts the old frontier (a cold boot IS a recovery).

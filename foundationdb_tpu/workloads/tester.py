@@ -461,8 +461,11 @@ def run_restart_spec(spec: dict) -> dict[str, Any]:
         return h.hexdigest()
 
     for phase_idx, phase in enumerate(spec.get("phases", [])):
+        import gc
+
         from ..core.trace import TraceSink, set_global_sink
 
+        gc.collect()  # same isolation contract as run_spec
         set_global_sink(TraceSink())
         undo_knobs = _apply_knobs(spec.get("knobs"))
         loop = sim_loop(seed=spec.get("seed", 1) * 1000 + phase_idx,
@@ -492,6 +495,7 @@ def run_restart_spec(spec: dict) -> dict[str, Any]:
             try:
                 pres = loop.run(main(), timeout_sim_seconds=3600)
             finally:
+                loop.shutdown()
                 undo_knobs()
         pres["sev_errors"] = len(global_sink().has_severity(40))
         results["phases"].append(pres)
@@ -512,6 +516,13 @@ def run_spec(spec: dict) -> dict[str, Any]:
     if spec.get("cluster", {}).get("kind") == "restart":
         return run_restart_spec(spec)
 
+    # Flush pending garbage BEFORE the deterministic run starts: suspended
+    # coroutines from earlier loops (tests, prior specs) must have their
+    # GC close paths run NOW, not at a collector-chosen instant inside
+    # this run (shutdown() below keeps this run from polluting the next).
+    import gc
+
+    gc.collect()
     # Fresh sink per spec: sev_errors must count THIS run only.
     set_global_sink(TraceSink())
     undo_knobs = _apply_knobs(spec.get("knobs"))
@@ -562,6 +573,7 @@ def run_spec(spec: dict) -> dict[str, Any]:
         try:
             results = loop.run(main(), timeout_sim_seconds=3600)
         finally:
+            loop.shutdown()
             undo_knobs()
     results["sev_errors"] = len(global_sink().has_severity(40))
     return results

@@ -42,3 +42,7 @@ def sim():
     loop = sim_loop(seed=12345)
     with loop_context(loop):
         yield loop
+    # Close every still-suspended actor NOW: leftovers otherwise sit in
+    # GC cycles until the collector fires inside a LATER test's sim run,
+    # perturbing its seed-determinism (see EventLoop.shutdown).
+    loop.shutdown()

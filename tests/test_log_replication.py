@@ -1,0 +1,249 @@
+"""K-way log replication (ref: TagPartitionedLogSystem.actor.cpp:339
+push to a replication-policy-selected set with full fsync quorum, :553
+confirmEpochLive, epochEnd :107 quorum recovery version).
+
+The tentpole contract: under `double`/`triple` log replication a
+PERMANENTLY DESTROYED log datadir loses nothing acked — every acked
+commit waited the full k-replica fsync quorum, epoch-end recovery
+excludes the k-1 worst durable cursors, and per-tag cursors fail over
+to a surviving replica of their tag."""
+
+import glob
+import os
+import shutil
+
+import pytest
+
+from foundationdb_tpu.cluster.log_system import (
+    TaggedMutation,
+    TaggedTLog,
+    TagPartitionedLogSystem,
+    log_replicas,
+    replica_set_for_tag,
+)
+from foundationdb_tpu.cluster.recovery import RecoverableShardedCluster
+from foundationdb_tpu.cluster.replication import policy_for_mode
+from foundationdb_tpu.core import loop_context
+from foundationdb_tpu.core.errors import OperationFailed, TLogStopped
+from foundationdb_tpu.core.runtime import sim_loop
+from foundationdb_tpu.kv.atomic import MutationType
+from foundationdb_tpu.cluster.interfaces import Mutation
+
+
+def _tm(tag, key=b"k", val=b"v"):
+    return TaggedMutation((tag,), Mutation(MutationType.SET_VALUE, key, val))
+
+
+# ---------------- routing ----------------
+
+def test_replica_sets_are_policy_distinct_and_deterministic():
+    replicas = log_replicas(4)
+    policy = policy_for_mode("double")
+    for tag in range(8):
+        s1 = replica_set_for_tag(tag % 4, replicas, policy)
+        s2 = replica_set_for_tag(tag % 4, replicas, policy)
+        assert s1 == s2, "routing must be a pure function of (tag, fleet)"
+        assert len(set(s1)) == 2
+        assert s1[0] == tag % 4, "primary is bestLocationFor"
+        zones = {replicas[i].locality.zoneid for i in s1}
+        assert len(zones) == 2, "replicas must be zone-distinct"
+
+
+def test_replication_factor_must_fit_fleet():
+    with pytest.raises(ValueError):
+        TagPartitionedLogSystem(n_logs=2, log_replication="triple")
+    # One-machine topology: double has nowhere for the second replica.
+    with pytest.raises(ValueError):
+        TagPartitionedLogSystem(
+            n_logs=2, log_replication="double",
+            topology={"n_dcs": 1, "machines_per_dc": 1},
+        )
+
+
+def test_push_lands_on_every_replica(sim):
+    async def main():
+        ls = TagPartitionedLogSystem(n_logs=3, log_replication="double")
+        await ls.push(0, 10, [_tm(0)], epoch=0)
+        rs = ls.replica_set_for_tag(0)
+        assert len(rs) == 2
+        for i in rs:
+            entries = await ls.logs[i].peek_tag(0, 0)
+            assert [(v, len(ms)) for v, ms in entries] == [(10, 1)]
+        # Non-replica logs still carry the (empty) version: chains stay
+        # contiguous on every log.
+        for i in set(range(3)) - set(rs):
+            assert ls.logs[i].version.get() == 10
+            entries = await ls.logs[i].peek_tag(0, 0)
+            assert [(v, len(ms)) for v, ms in entries] == [(10, 0)]
+
+    sim.run(main())
+
+
+def test_push_stalls_rather_than_shed_a_copy(sim):
+    from foundationdb_tpu.core.errors import TLogFailed
+
+    async def main():
+        ls = TagPartitionedLogSystem(n_logs=2, log_replication="double")
+        ls.logs[1].reachable = False
+        with pytest.raises(TLogFailed):
+            await ls.push(0, 5, [_tm(0)], epoch=0)
+
+    sim.run(main())
+
+
+def test_log_push_drop_is_retried_back_into_quorum():
+    loop = sim_loop(seed=77, buggify=True)
+    # Force the site on: every replica's first append attempt errors and
+    # must be retried (never acked around, never failed outright).
+    loop._buggify_enabled["log_push_drop"] = True
+    with loop_context(loop):
+        async def main():
+            ls = TagPartitionedLogSystem(n_logs=2, log_replication="double")
+            await ls.push(0, 7, [_tm(0)], epoch=0)
+            for log in ls.logs:
+                assert log.durable.get() == 7
+            entries = await ls.logs[0].peek_tag(0, 0)
+            assert entries and entries[0][0] == 7
+
+        loop.run(main(), timeout_sim_seconds=60)
+    loop.shutdown()
+
+
+# ---------------- epoch-end quorum ----------------
+
+def test_lock_quorum_excludes_wiped_log(sim):
+    async def main():
+        ls = TagPartitionedLogSystem(n_logs=2, log_replication="double")
+        for v in range(1, 6):
+            await ls.push((v - 1) * 10, v * 10, [_tm(0)], epoch=0)
+        assert ls.durable_version() == 50
+        # Model a destroyed datadir: log0 comes back EMPTY.
+        ls.log_sets[0][0] = TaggedTLog(0)
+        recovery = ls.lock(1)
+        assert recovery == 50, "k-1 worst cursors are excludable"
+        # The wiped log's lost window is marked unavailable so tag
+        # cursors route around it.
+        assert ls.logs[0].available_from == 50
+        # The surviving replica still serves the whole window.
+        view = ls.tag_view(0)
+        entries = await view.peek(0)
+        assert [v for v, ms in entries if ms] == [10, 20, 30, 40, 50]
+
+    sim.run(main())
+
+
+def test_single_mode_lock_keeps_min_semantics(sim):
+    async def main():
+        ls = TagPartitionedLogSystem(n_logs=2, log_replication="single")
+        await ls.push(0, 10, [_tm(0)], epoch=0)
+        assert ls.lock(1) == 10  # budget 0: plain min across the logs
+
+    sim.run(main())
+
+
+# ---------------- confirmEpochLive under k-way ----------------
+
+def test_confirm_epoch_live_fenced_by_locked_quorum(sim):
+    """A partitioned old master whose QUORUM is locked must not hand out
+    read versions even when a minority of its logs is still live (the
+    satellite contract extending log_system.confirm_epoch_live)."""
+
+    async def main():
+        ls = TagPartitionedLogSystem(n_logs=3, log_replication="double")
+        await ls.push(0, 10, [_tm(0)], epoch=1)
+        # Healthy: the old generation can confirm.
+        await ls.confirm_epoch_live(1)
+
+        # A successor locked an n-(k-1)=2 quorum; those logs now answer
+        # with the fence and the old master must fail outright.
+        ls.logs[0].lock(2)
+        ls.logs[1].lock(2)
+        with pytest.raises(TLogStopped):
+            await ls.confirm_epoch_live(1)
+
+        # Partition variant: the locked quorum is DARK; only the minority
+        # unlocked log answers. One confirmation proves nothing — the
+        # successor's quorum cannot be ruled out.
+        ls.logs[0].reachable = False
+        ls.logs[1].reachable = False
+        with pytest.raises(OperationFailed):
+            await ls.confirm_epoch_live(1)
+
+        # The minority alone is also insufficient for the SUCCESSOR
+        # until its quorum answers again.
+        with pytest.raises(OperationFailed):
+            await ls.confirm_epoch_live(2)
+        ls.logs[0].reachable = True
+        ls.logs[1].reachable = True
+        await ls.confirm_epoch_live(2)  # quorum answers, unfenced for 2
+
+    sim.run(main())
+
+
+# ---------------- destroyed datadir, full-cluster ----------------
+
+def _wipe(prefix_glob: str) -> list[str]:
+    victims = glob.glob(prefix_glob)
+    for v in victims:
+        (shutil.rmtree if os.path.isdir(v) else os.remove)(v)
+    return victims
+
+
+@pytest.mark.parametrize("wiped_log", [0, 1])
+def test_destroyed_log_datadir_loses_nothing_acked(tmp_path, wiped_log):
+    """The acceptance contract in-process: under double log replication,
+    destroy ONE log's datadir between incarnations; every acked write
+    survives recovery (and the cluster stays writable)."""
+    datadir = str(tmp_path / "d")
+    kw = dict(n_storage=4, n_logs=2, replication="double",
+              log_replication="double", shard_boundaries=[b"m"],
+              datadir=datadir)
+    acked = [(b"k%02d" % i, b"v%d" % i) for i in range(30)]
+
+    loop = sim_loop(seed=5)
+    with loop_context(loop):
+        cluster = RecoverableShardedCluster(**kw).start()
+        db = cluster.database()
+
+        async def write():
+            for k, v in acked:
+                await db.set(k, v)
+            cluster.stop()
+
+        loop.run(write(), timeout_sim_seconds=600)
+    loop.shutdown()
+
+    assert _wipe(f"{datadir}/log{wiped_log}*"), "nothing was destroyed?"
+
+    loop = sim_loop(seed=6)
+    with loop_context(loop):
+        cluster = RecoverableShardedCluster(**kw).start()
+        db = cluster.database()
+
+        async def verify():
+            for k, v in acked:
+                got = await db.get(k)
+                assert got == v, (k, got)
+            await db.set(b"after", b"wipe")
+            assert await db.get(b"after") == b"wipe"
+            cluster.stop()
+
+        loop.run(verify(), timeout_sim_seconds=600)
+    loop.shutdown()
+
+
+# ---------------- spec validation (satellite) ----------------
+
+def test_spec_kw_rejects_unsatisfiable_log_replication():
+    from foundationdb_tpu.cluster.multiprocess import _spec_kw
+
+    with pytest.raises(ValueError, match="log_replication"):
+        _spec_kw({"n_logs": 2, "log_replication": "triple"})
+    with pytest.raises(ValueError, match="n_dcs"):
+        _spec_kw({"n_logs": 2, "n_log_hosts": 2, "regions": True})
+    with pytest.raises(ValueError, match="second DC's log hosts"):
+        _spec_kw({"n_logs": 2, "n_log_hosts": 1, "regions": True,
+                  "topology": {"n_dcs": 2, "machines_per_dc": 2}})
+    # A satisfiable spec parses and carries the mode through.
+    kw = _spec_kw({"n_logs": 2, "log_replication": "double"})
+    assert kw["log_replication"] == "double"

@@ -6,6 +6,7 @@ failure reproducible from its seed alone; sim/config.py's contract).
 
     python tools/seed_sweep.py --spec specs/chaos_topology.json --seeds 1:50
     python tools/seed_sweep.py --randomized --seeds 100:120
+    python tools/seed_sweep.py --preset regions --seeds 0:20
     python tools/seed_sweep.py --spec specs/chaos_topology.json \
         --seeds 7,99,4242 --check-determinism
 
@@ -13,6 +14,12 @@ failure reproducible from its seed alone; sim/config.py's contract).
 (== 0:N). With --check-determinism every seed runs TWICE and the final
 keyspace fingerprints must match — the simulator's replay contract.
 Exit status: number of failing seeds (0 == sweep green).
+
+--preset regions sweeps the two-DC region config (specs/
+chaos_regions.json: DC kills + machine attrition over remote log
+shipping) with per-seed randomized k-way log replication, conflict-set
+backend (CONFLICT_SET_IMPL, the same draw table sim/config.py uses) and
+push/router knobs — every failure prints its full repro spec.
 """
 
 from __future__ import annotations
@@ -23,6 +30,48 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def regions_spec(seed: int) -> dict:
+    """Per-seed variation of the two-region chaos base: randomized k-way
+    log replication, conflict-set backend, and the push-retry / router
+    knobs (the same categorical CONFLICT_SET_IMPL weights sim/config.py
+    draws). Deterministic per seed — the printed spec IS the repro."""
+    import random
+
+    base_path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "specs", "chaos_regions.json")
+    with open(base_path) as f:
+        spec = json.load(f)
+    rng = random.Random(seed)
+    spec["seed"] = seed
+    cluster = spec["cluster"]
+    # Primary-DC log domains bound the mode (machines_per_dc machines).
+    cluster["log_replication"] = rng.choice(["single", "double", "double"])
+    cluster["n_logs"] = rng.randint(
+        2 if cluster["log_replication"] == "double" else 1, 3
+    )
+    knobs = spec.setdefault("knobs", {})
+    if rng.random() < 0.5:
+        knobs["server:CONFLICT_SET_IMPL"] = rng.choice(
+            ("native", "native", "oracle", "tpu")
+        )
+    if rng.random() < 0.5:
+        knobs["server:LOG_PUSH_RETRIES"] = rng.randint(1, 4)
+    if rng.random() < 0.5:
+        knobs["server:LOG_PUSH_RETRY_DELAY"] = round(
+            0.01 + rng.random() * 0.19, 4
+        )
+    if rng.random() < 0.5:
+        knobs["server:LOG_ROUTER_RETRY_INTERVAL"] = round(
+            0.02 + rng.random() * 0.48, 4
+        )
+    # Every few seeds turn the DC kill into a double tap.
+    for w in spec["workloads"]:
+        if w["name"] == "MachineAttrition":
+            w["dc_kills"] = rng.choice([1, 1, 2])
+            w["kills"] = rng.randint(1, 2)
+    return spec
 
 
 def parse_seeds(spec: str) -> list[int]:
@@ -41,13 +90,18 @@ def main() -> int:
     ap.add_argument("--randomized", action="store_true",
                     help="derive each seed's spec via sim.config."
                          "generate_config instead of --spec")
+    ap.add_argument("--preset", choices=["regions"],
+                    help="named sweep preset: 'regions' = two-DC log "
+                         "shipping chaos (DC kills + attrition) with "
+                         "per-seed randomized replication modes")
     ap.add_argument("--seeds", default="20",
                     help='"lo:hi", "a,b,c", or a count N (default 20)')
     ap.add_argument("--check-determinism", action="store_true",
                     help="run every seed twice; fingerprints must match")
     args = ap.parse_args()
-    if bool(args.spec) == bool(args.randomized):
-        ap.error("exactly one of --spec / --randomized is required")
+    if sum(map(bool, (args.spec, args.randomized, args.preset))) != 1:
+        ap.error("exactly one of --spec / --randomized / --preset is "
+                 "required")
 
     if sys.flags.hash_randomization:
         # Hash randomization perturbs set/dict iteration, which feeds the
@@ -66,9 +120,12 @@ def main() -> int:
 
     failures: list[int] = []
     for seed in parse_seeds(args.seeds):
-        spec = generate_config(seed) if args.randomized else {
-            **base, "seed": seed
-        }
+        if args.randomized:
+            spec = generate_config(seed)
+        elif args.preset == "regions":
+            spec = regions_spec(seed)
+        else:
+            spec = {**base, "seed": seed}
         try:
             res = run_spec(spec)
             ok = bool(res.get("ok")) and not res.get("sev_errors")
