@@ -897,6 +897,246 @@ def run_sharded_sweep_child(batch_txns: int, caps, seed: int,
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
+def measure_pipeline_sweep(batch_txns: int, depths, seed: int,
+                           key_space: int = 1 << 20, n_batches: int = 6):
+    """ISSUE-7 evidence leg: the submit/verdicts pipeline at depths
+    {1,2,4} x one batch size, with the per-stage breakdown and the
+    MEASURED overlap.
+
+    Three sub-legs, all on pre-built columnar wire batches (the deployed
+    feed, resolver/wire.py):
+
+      pack        vectorized pack_batch_wire vs the legacy object loop
+                  (pack_batch) on identical batches — the ISSUE's <=10 ms
+                  / >=10x acceptance numbers, measured at the bench shape
+                  (5 reads + 2 writes per txn) AND the point-write shape.
+      depth legs  fresh conflict set per depth; submit keeps `depth`
+                  batches in flight, verdicts consume in order. The
+                  compile batch is excluded (as in the r07 sweeps) and
+                  counted. overlap_fraction = 1 - wall(depth)/wall(1):
+                  on the CPU backend device work shares the host cores,
+                  so ~0 is the HONEST expectation — the depth legs prove
+                  measured in-flight depth and bit-identical verdicts;
+                  the overlap payoff is the real-chip number.
+      differential  every depth's status stream must equal depth 1's bit
+                  for bit.
+    """
+    import numpy as np
+
+    from foundationdb_tpu.kv.keys import KeyRange
+    from foundationdb_tpu.resolver.packing import pack_batch
+    from foundationdb_tpu.resolver.tpu import ConflictSetTPU
+    from foundationdb_tpu.resolver.types import TxnConflictInfo
+    from foundationdb_tpu.resolver.wire import WireBatch, pack_batch_wire
+
+    rng = np.random.default_rng(seed)
+    sampler = uniform_sampler(key_space)
+    version0 = 1_000_000
+    # Pre-build object + wire forms of every batch OUTSIDE the timed
+    # region (wire bytes arrive from proxies in deployment).
+    batches = []
+    for b in range(n_batches + 1):
+        txns = gen_batch(rng, batch_txns, version0 + b * batch_txns, sampler)
+        batches.append((txns, WireBatch.from_bytes(
+            WireBatch.from_txns(txns).to_bytes()
+        )))
+
+    out: dict = {"batch_txns": batch_txns, "n_batches": n_batches}
+
+    # -- pack leg --
+    def med(f, reps=3):
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            f()
+            ts.append(time.perf_counter() - t0)
+        return float(np.median(ts)) * 1e3
+
+    txns0, wb0 = batches[0]
+    loop_ms = med(lambda: pack_batch(txns0, 0, 2), reps=2)
+    vec_ms = med(lambda: pack_batch_wire(wb0, 0, 2))
+    pt = [
+        TxnConflictInfo(version0, [KeyRange(k8(int(a)), k8(int(a) + 1))],
+                        [KeyRange(k8(int(w)), k8(int(w) + 1))])
+        for a, w in zip(rng.integers(0, key_space, batch_txns),
+                        rng.integers(0, key_space, batch_txns))
+    ]
+    wpt = WireBatch.from_bytes(WireBatch.from_txns(pt).to_bytes())
+    out["pack"] = {
+        "shape_bench_5r2w": {
+            "python_loop_ms": round(loop_ms, 1),
+            "vectorized_ms": round(vec_ms, 1),
+            "speedup": round(loop_ms / vec_ms, 2),
+        },
+        "shape_point_1r1w": {
+            "python_loop_ms": round(med(lambda: pack_batch(pt, 0, 2),
+                                        reps=2), 1),
+            "vectorized_ms": round(med(lambda: pack_batch_wire(wpt, 0, 2)),
+                                   1),
+        },
+    }
+    p = out["pack"]["shape_point_1r1w"]
+    p["speedup"] = round(p["python_loop_ms"] / p["vectorized_ms"], 2)
+    log(f"[pipeline pack] 5r2w loop {loop_ms:.0f} ms -> vec {vec_ms:.0f} ms "
+        f"({loop_ms / vec_ms:.1f}x); point "
+        f"{p['python_loop_ms']:.0f} -> {p['vectorized_ms']:.0f} ms "
+        f"({p['speedup']:.1f}x)")
+
+    # -- depth legs --
+    legs = []
+    ref_statuses = None
+    sync_wall = None
+    for depth in ("warm",) + tuple(depths):
+        cs = ConflictSetTPU(max_key_bytes=8, initial_capacity=1 << 18,
+                            min_capacity=1 << 18)
+        v = version0
+        # Compile batch (excluded from the sample, as in r07).
+        h = cs.submit(v, 0, batches[0][1])
+        cs.verdicts(h)
+        handles = []
+        statuses = []
+        stage = {k: 0.0 for k in
+                 ("pack_ms", "h2d_ms", "device_ms", "d2h_ms")}
+        lat = []
+
+        def consume(handles):
+            t, hh = handles.pop(0)
+            statuses.append(cs.verdicts(hh))
+            lat.append(time.perf_counter() - t)
+            stage["pack_ms"] += hh.pack_ms
+            stage["h2d_ms"] += hh.dispatch_ms
+            stage["device_ms"] += hh.device_ms
+            stage["d2h_ms"] += hh.d2h_ms
+
+        # The "warm" pseudo-leg runs the whole depth-1 sequence once so
+        # every shape the measured legs meet (fast path, growth
+        # compactions) is compiled before ANY timed leg — without it the
+        # first leg pays the compiles and the deeper legs' overlap would
+        # measure the compiler, not the pipeline.
+        bound = 1 if depth == "warm" else depth
+        t_run0 = time.perf_counter()
+        for b in range(1, n_batches + 1):
+            v = version0 + b * batch_txns
+            if len(handles) >= bound:
+                consume(handles)
+            handles.append(
+                (time.perf_counter(), cs.submit(v, 0, batches[b][1]))
+            )
+        while handles:
+            consume(handles)
+        wall = time.perf_counter() - t_run0
+        flat = [int(s) for st in statuses for s in st]
+        if depth == "warm":
+            continue
+        if ref_statuses is None:
+            ref_statuses = flat
+            sync_wall = wall
+        leg = {
+            "depth_configured": depth,
+            "depth_measured": cs.max_inflight,
+            "wall_s": round(wall, 2),
+            "txns_per_sec": round(n_batches * batch_txns / wall, 1),
+            "batch_p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 1),
+            "stage_ms_per_batch": {
+                k: round(vv / n_batches, 1) for k, vv in stage.items()
+            },
+            "overlap_fraction": round(1.0 - wall / sync_wall, 3),
+            "verdicts_match_sync": flat == ref_statuses,
+            "compile_batches_excluded": 1,
+        }
+        legs.append(leg)
+        log(f"[pipeline depth {depth}] measured {leg['depth_measured']} "
+            f"wall {leg['wall_s']}s overlap {leg['overlap_fraction']} "
+            f"match {leg['verdicts_match_sync']}")
+    out["depths"] = legs
+    out["all_verdicts_bit_identical"] = all(
+        leg["verdicts_match_sync"] for leg in legs
+    )
+    return out
+
+
+def measure_pipeline_ycsbe_differential(total_txns: int, seed: int,
+                                        stage: int = 4096,
+                                        n_reads: int = 64,
+                                        scan_max: int = 8,
+                                        key_space: int = 1 << 26,
+                                        depth: int = 4):
+    """The acceptance differential: the YCSB-E staged run (BASELINE
+    config 3 shape) executed twice on identical draws — synchronous
+    (depth 1) and pipelined (depth `depth`) — and the FULL status streams
+    compared bit for bit."""
+    import numpy as np
+
+    from foundationdb_tpu.resolver.tpu import ConflictSetTPU
+    from foundationdb_tpu.resolver.wire import WireBatch
+
+    version0 = 10_000_000
+    rng = np.random.default_rng(seed)
+    pool_n = min(-(-total_txns // stage), 16)
+    pool = []
+    for _ in range(pool_n):
+        arrs = ycsbe_stage_arrays(rng, stage, version0, key_space,
+                                  n_reads, scan_max)
+        txns = ycsbe_txns(*arrs)
+        pool.append(WireBatch.from_bytes(WireBatch.from_txns(txns).to_bytes()))
+
+    def run(run_depth: int):
+        cs = ConflictSetTPU(max_key_bytes=8, initial_capacity=1 << 18)
+        handles = []
+        statuses = []
+
+        def consume():
+            statuses.append(cs.verdicts(handles.pop(0)))
+
+        # Warm batch, identical in BOTH runs (same state mutation, so the
+        # differential stays exact): without it the first run pays every
+        # XLA compile and the second inherits the process-global kernel
+        # cache — the measured "overlap" would mostly be compile time.
+        cs.verdicts(cs.submit(version0, 0, pool[0]))
+        t0 = time.perf_counter()
+        done = 0
+        chunk_i = 0
+        while done < total_txns:
+            n = min(stage, total_txns - done)
+            wb = pool[chunk_i % pool_n]
+            if n < wb.n_txns:
+                wb = wb.slice(0, n)
+            v = version0 + done + n
+            if len(handles) >= run_depth:
+                consume()
+            handles.append(cs.submit(v, 0, wb))
+            done += n
+            chunk_i += 1
+        while handles:
+            consume()
+        wall = time.perf_counter() - t0
+        flat = np.concatenate([np.asarray(s, dtype=np.int8)
+                               for s in statuses])
+        return flat, wall, cs.max_inflight
+
+    # Pipelined FIRST: the two runs share the process-global kernel
+    # cache, so whichever runs first pays any residual first-encounter
+    # compiles (growth-compaction shapes) — charging them to the
+    # pipelined wall makes the reported overlap conservative.
+    pipe_st, pipe_wall, measured = run(depth)
+    sync_st, sync_wall, _ = run(1)
+    identical = bool(np.array_equal(sync_st, pipe_st))
+    out = {
+        "total_txns": total_txns, "n_reads": n_reads, "stage": stage,
+        "depth": depth, "depth_measured": measured,
+        "sync_wall_s": round(sync_wall, 1),
+        "pipelined_wall_s": round(pipe_wall, 1),
+        "overlap_fraction": round(1.0 - pipe_wall / sync_wall, 3),
+        "run_order": "pipelined_first: residual compiles land in the "
+                     "pipelined wall, overlap is a floor",
+        "verdicts_bit_identical": identical,
+        "conflict_rate": round(float((sync_st != 0).mean()), 4),
+    }
+    log(f"[pipeline ycsbe] {total_txns} txns identical={identical} "
+        f"sync {sync_wall:.0f}s pipe {pipe_wall:.0f}s depth {measured}")
+    return out
+
+
 def measure_multiprocess_commit(n_commits: int = 200):
     """End-to-end commit p50 through the DEPLOYED pipeline: a real
     3-process cluster (log/storage/txn hosts over localhost TCP), the txn
@@ -1107,6 +1347,16 @@ def main() -> None:
                     help="run ONLY the mesh-sharded capacity sweep (child "
                          "process pins the virtual device count) and write "
                          "it to --bench-out")
+    ap.add_argument("--pipeline-sweep", action="store_true",
+                    help="run ONLY the ISSUE-7 pipeline legs (pack "
+                         "comparison, depth 1/2/4 sweep, YCSB-E "
+                         "pipelined-vs-sync differential) and write them "
+                         "to --bench-out")
+    ap.add_argument("--pipeline-ycsbe-txns", type=int,
+                    default=int(os.environ.get("BENCH_PIPE_YCSBE_TXNS",
+                                               1_000_000)),
+                    help="txn count of the pipelined-vs-sync YCSB-E "
+                         "differential (0 skips the leg)")
     ap.add_argument("--sharded-sweep-child", action="store_true",
                     help="internal: run the sharded sweep in THIS process "
                          "(device count already pinned) and print JSON")
@@ -1135,6 +1385,29 @@ def main() -> None:
     )
     sharded_batch = int(os.environ.get("BENCH_SHARDED_BATCH", 512))
     sharded_nshards = int(os.environ.get("BENCH_SHARDED_NSHARDS", 4))
+
+    if args.pipeline_sweep:
+        _enable_compile_cache()
+        depths = tuple(int(x) for x in os.environ.get(
+            "BENCH_PIPE_DEPTHS", "1,2,4").split(","))
+        pipe_batch = int(os.environ.get("BENCH_PIPE_BATCH", 65536))
+        sweep = measure_pipeline_sweep(pipe_batch, depths, args.seed,
+                                       args.key_space)
+        payload = {"pipeline_sweep": sweep}
+        if args.pipeline_ycsbe_txns:
+            payload["pipeline_ycsbe_differential"] = (
+                measure_pipeline_ycsbe_differential(
+                    args.pipeline_ycsbe_txns, args.seed
+                )
+            )
+        _write_bench(payload, args.bench_out)
+        print(json.dumps({
+            "metric": "pipeline_sweep",
+            "all_verdicts_bit_identical":
+                sweep["all_verdicts_bit_identical"],
+            "detail": payload,
+        }))
+        return
 
     if args.capacity_sweep:
         _enable_compile_cache()

@@ -136,6 +136,12 @@ class ResolveTransactionBatchRequest:
     transactions: list  # list[TxnConflictInfo]
     system_mutations: tuple = ()
     committed_feedback: tuple = ()
+    # Columnar wire form of `transactions` (resolver/wire.py WireBatch
+    # bytes, SERVER_KNOBS.RESOLVER_WIRE_BATCH): device-backed resolvers
+    # pack it with the vectorized encoder instead of walking txn objects;
+    # cross-process requests ship ONLY the wire form (transactions empty)
+    # so the commit path never serializes per-range Python objects.
+    wire: bytes | None = None
     # Generation fence for resolver HOSTS serving multiple generations
     # over reused endpoints (multiprocess tier): a deposed proxy's
     # in-flight batch must not merge into the successor's conflict state.

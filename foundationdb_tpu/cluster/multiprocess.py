@@ -228,8 +228,17 @@ def read_cluster_file(path: str) -> Optional[dict]:
 
 
 def _spec_kw(spec: dict) -> dict:
+    from ..resolver.factory import validate_conflict_set_impl
     from .replication import policy_for_mode
 
+    # Caught at spec parse: every host class eventually recruits a
+    # conflict set via the factory, and an unknown impl used to surface
+    # only as an opaque per-generation recruitment failure inside the
+    # resolver host.
+    validate_conflict_set_impl(
+        spec.get("conflict_set_impl")
+        if spec.get("conflict_set_impl") is not None else None
+    )
     n_logs = spec.get("n_logs", 2)
     n_log_hosts = spec.get("n_log_hosts", 1)
     if n_log_hosts > n_logs:
@@ -667,7 +676,8 @@ class ResolverHost:
             return None
         if isinstance(req, ResolverStatusRequest):
             r = self.roles[req.idx]
-            return (r.keys_resolved, tuple(r.key_sample()))
+            return (r.keys_resolved, tuple(r.key_sample()),
+                    r.pipeline_status())
         if isinstance(req, ResolverSkipWindowRequest):
             self._fence(req.epoch)
             await self.roles[req.idx].skip_window(req.prev_version,
@@ -718,6 +728,7 @@ class RemoteResolver:
         self._ctrl = transport.remote_stream(addr, WLTOKEN_RESOLVER_BASE)
         self.keys_resolved = 0
         self._sample: tuple = ()
+        self.pipeline = None
 
     async def _rpc(self, stream, req):
         stream.send(req)
@@ -733,6 +744,11 @@ class RemoteResolver:
     async def resolve_batch(self, br):
         from ..resolver.types import ConflictBatchResult
 
+        if getattr(br, "wire", None) is not None and br.transactions:
+            # The wire bytes ARE the batch; shipping the object list too
+            # would double the RPC payload (the proxy keeps its own txn
+            # list — this request's copy is redundant on the wire).
+            br.transactions = []
         reply = await self._rpc(self._resolve_s, br)
         out = ConflictBatchResult(list(reply.statuses))
         out.state_mutations = reply.state_mutations
@@ -746,11 +762,14 @@ class RemoteResolver:
         )
 
     async def refresh_status(self) -> None:
-        kr, sample = await self._rpc(
+        kr, sample, *rest = await self._rpc(
             self._ctrl, ResolverStatusRequest(self.idx)
         )
         self.keys_resolved = kr
         self._sample = sample
+        # Pipeline breakdown of the REMOTE role (pack/h2d/device/d2h +
+        # in-flight depth), for the txn host's status json.
+        self.pipeline = rest[0] if rest else None
 
     def key_sample(self) -> list:
         return list(self._sample)

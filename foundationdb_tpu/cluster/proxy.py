@@ -335,6 +335,20 @@ class CommitProxy:
                 if not r.reply.is_set():
                     r.reply.send_error(err)
 
+    def _wire_on(self) -> bool:
+        return bool(SERVER_KNOBS.RESOLVER_WIRE_BATCH)
+
+    def _encode_wire(self, txns):
+        """Columnar wire bytes of a resolve batch (resolver/wire.py),
+        knob-gated. Built proxy-side — many proxies columnarize
+        concurrently, ONE resolver packs, so this moves the per-object
+        walk off the serialized resolve path."""
+        if not self._wire_on():
+            return None
+        from ..resolver.wire import WireBatch
+
+        return WireBatch.from_txns(txns).to_bytes()
+
     async def _resolve_multi(self, prev_version, version, txns, reqs):
         """Fan resolution across the resolver partition and merge (ref:
         ResolutionRequestBuilder clipping per resolver,
@@ -355,15 +369,17 @@ class CommitProxy:
         feedback, self._feedback = tuple(self._feedback), []
         batch_reqs = []
         for i, role in enumerate(self.resolvers):
+            clipped = clip_txns(
+                txns, self.resolver_config.coverage(i, version)
+            )
             batch_reqs.append(ResolveTransactionBatchRequest(
                 prev_version=prev_version,
                 version=version,
                 last_receive_version=(
                     self._last_receive if i == 0 else prev_version
                 ),
-                transactions=clip_txns(
-                    txns, self.resolver_config.coverage(i, version)
-                ),
+                transactions=clipped,
+                wire=self._encode_wire(clipped),
                 system_mutations=sys_muts if i == 0 else (),
                 committed_feedback=feedback if i == 0 else (),
                 epoch=self.generation,
@@ -522,11 +538,15 @@ class CommitProxy:
                 prev_version, version, txns, reqs
             )
         elif self.resolver_endpoint is not None:
+            # Cross-process hop: ship ONLY the columnar wire form — the
+            # resolver-side pack is then the vectorized encoder and the
+            # RPC never serializes per-range txn objects.
             resolve_req = ResolveTransactionBatchRequest(
                 prev_version=prev_version,
                 version=version,
                 last_receive_version=prev_version,
-                transactions=txns,
+                transactions=[] if self._wire_on() else txns,
+                wire=self._encode_wire(txns),
                 epoch=self.generation,
             )
             result = await self._call_endpoint(
@@ -538,6 +558,7 @@ class CommitProxy:
                 version=version,
                 last_receive_version=prev_version,
                 transactions=txns,
+                wire=self._encode_wire(txns),
                 epoch=self.generation,
             )
             result = await self.resolver.resolve_batch(resolve_req)

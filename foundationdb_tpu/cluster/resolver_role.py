@@ -8,16 +8,50 @@ so batches resolve in commit-version order no matter how proxies race, then
 detects conflicts and advances the resolver's version. The OCC memory
 window is MAX_WRITE_TRANSACTION_LIFE_VERSIONS behind the batch version
 (:157, fdbserver/Knobs.cpp:61).
+
+PIPELINED CONSUMPTION (device-backed conflict sets). A backend exposing
+submit()/verdicts() (ConflictSetTPU, ShardedConflictSetTPU) splits a
+resolve into a dispatch that never syncs the device and a verdict D2H.
+The role exploits the split with TWO version chains:
+
+  version    gates DISPATCH: window (prev, v] submits as soon as window
+             prev dispatched — the conflict-set state update is ordered
+             by dispatch, which is all correctness needs (the device
+             state is a pure function of the dispatch sequence).
+  _consumed  gates CONSUMPTION: verdicts are read back and replied in
+             commit-version order, so proxies observe exactly the
+             synchronous path's reply semantics.
+
+Between a window's dispatch and its consumption, up to
+SERVER_KNOBS.TPU_PIPELINE_DEPTH batches are in flight on the device —
+the phase-1/2/3 steps of batch N+1 overlap batch N's readback, which is
+what turns the batch-scaled kernel into a batch-scaled pipeline
+(ROADMAP: h2d+pack < 20% of batch latency). Verdicts are bit-identical
+to the synchronous path because neither the dispatch order nor the
+per-batch device program changes — only WHEN the host blocks.
+
+Batches may arrive as wire bytes (resolver/wire.py columnar batches,
+SERVER_KNOBS.RESOLVER_WIRE_BATCH): device backends pack them with the
+vectorized encoder, object backends decode once.
 """
 
 from __future__ import annotations
 
+from collections import deque
+
 from ..core.actors import NotifiedVersion
 from ..core.errors import OperationFailed
 from ..core.knobs import SERVER_KNOBS
+from ..core.stats import ContinuousSample
 from ..core.trace import TraceEvent
 from ..resolver.types import ConflictBatchResult
 from .interfaces import ResolveTransactionBatchRequest
+
+# Stage keys of the pipeline breakdown, in pipeline order. The seams:
+# pack = host rows -> fused buffer; h2d = host fence ranking + transfer/
+# kernel ENQUEUE; device = wait until the device finished the batch at
+# consumption; d2h = the verdict readback itself.
+_STAGES = ("pack_ms", "h2d_ms", "device_ms", "d2h_ms")
 
 
 class ResolverRole:
@@ -35,10 +69,15 @@ class ResolverRole:
     async def skip_window(self, prev_version: int, version: int) -> None:
         """Advance the version chain over a window that resolved nothing
         (a proxy batch that failed before reaching this resolver). No-op
-        if the window was already resolved — idempotent by construction."""
+        if the window was already resolved — idempotent by construction.
+        Both chains advance: a successor's verdict consumption waits on
+        _consumed exactly like its dispatch waits on version."""
         await self.version.when_at_least(prev_version)
         if self.version.get() == prev_version:
             self.version.set(version)
+        await self._consumed.when_at_least(prev_version)
+        if self._consumed.get() == prev_version:
+            self._consumed.set(version)
 
     def __init__(self, conflict_set, init_version: int = 0):
         from ..core.actors import PromiseStream
@@ -46,6 +85,12 @@ class ResolverRole:
         self.cs = conflict_set
         self.resolve_stream = PromiseStream()
         self.version = NotifiedVersion(init_version)
+        # Consumption chain + in-flight window queue (pipelined path).
+        self._consumed = NotifiedVersion(init_version)
+        self._inflight_q: deque[int] = deque()
+        self.max_inflight = 0
+        # Per-stage timing reservoirs (status json pipeline block).
+        self.stage_samples = {k: ContinuousSample(256) for k in _STAGES}
         # Counters (ref: Resolver.actor.cpp:155-158 g_counters).
         self.conflict_batches = 0
         self.conflict_transactions = 0
@@ -80,6 +125,33 @@ class ResolverRole:
     def key_sample(self) -> list[bytes]:
         return list(self._sample)
 
+    def pipeline_status(self) -> dict:
+        """Per-stage timing breakdown + live depth for `status json`: the
+        observable form of the ROADMAP bar "h2d+pack < 20% of batch
+        latency" on a running cluster."""
+        def pct(s, q):
+            v = s.percentile(q)
+            return round(v, 3) if v is not None else None
+
+        return {
+            "depth_configured": SERVER_KNOBS.TPU_PIPELINE_DEPTH,
+            "in_flight": len(self._inflight_q),
+            "max_in_flight_measured": self.max_inflight,
+            "stages": {
+                k: {"p50": pct(s, 0.5), "p99": pct(s, 0.99),
+                    "samples": s.population}
+                for k, s in self.stage_samples.items()
+            },
+        }
+
+    def _record_stages(self, handle) -> None:
+        for key, val in (("pack_ms", handle.pack_ms),
+                         ("h2d_ms", handle.dispatch_ms),
+                         ("device_ms", handle.device_ms),
+                         ("d2h_ms", handle.d2h_ms)):
+            if val is not None:
+                self.stage_samples[key].add_sample(val)
+
     def apply_feedback(self, feedback) -> None:
         """Proxy feedback: which txns of an earlier window globally
         committed — promote their retained system mutations (a resolver
@@ -101,6 +173,43 @@ class ResolverRole:
             for v in sorted(self.state_store)
             if above < v <= upto
         )
+
+    # -- batch accounting shared by both resolve paths --
+
+    def _account_batch(self, req, wb, n_txns: int) -> None:
+        self.total_transactions += n_txns
+        if wb is not None:
+            self.keys_resolved += wb.total_ranges()
+            # Balancer key sample without a per-row loop: up to
+            # _SAMPLE_CAP evenly strided write-begin keys through the
+            # deterministic reservoir.
+            nw = len(wb.wb_len)
+            if nw:
+                blob = wb.blob
+                step = max(1, nw // self._SAMPLE_CAP)
+                for i in range(0, nw, step):
+                    o = int(wb.wb_off[i])
+                    self._sample_key(
+                        blob[o : o + int(wb.wb_len[i])].tobytes()
+                    )
+        else:
+            for t in req.transactions:
+                self.keys_resolved += len(t.read_ranges) + len(t.write_ranges)
+                for w in t.write_ranges:
+                    self._sample_key(w.begin)
+
+    def _retain_state(self, req) -> None:
+        # Retain this window's system mutations until the proxy reports
+        # the merged verdicts (apply_feedback), then prune the write-life
+        # horizon.
+        sys_muts = getattr(req, "system_mutations", ())
+        if sys_muts:
+            self._pending_state[req.version] = list(sys_muts)
+        horizon = req.version - SERVER_KNOBS.MAX_WRITE_TRANSACTION_LIFE_VERSIONS
+        for v in [v for v in self.state_store if v < horizon]:
+            del self.state_store[v]
+        for v in [v for v in self._pending_state if v < horizon]:
+            del self._pending_state[v]
 
     async def resolve_batch(
         self, req: ResolveTransactionBatchRequest
@@ -124,11 +233,60 @@ class ResolverRole:
                 f"resolver window ({req.prev_version}, {req.version}] "
                 f"already superseded at version {self.version.get()}"
             )
+        wb = None
+        wire = getattr(req, "wire", None)
+        if wire is not None:
+            from ..resolver.wire import WireBatch
+
+            wb = WireBatch.from_bytes(wire)
+        n_txns = wb.n_txns if wb is not None else len(req.transactions)
         new_oldest = max(
             0, req.version - SERVER_KNOBS.MAX_WRITE_TRANSACTION_LIFE_VERSIONS
         )
+        pipelined = (
+            hasattr(self.cs, "submit")
+            and SERVER_KNOBS.TPU_PIPELINE_DEPTH > 1
+        )
+        if pipelined:
+            result = await self._resolve_pipelined(req, wb, n_txns,
+                                                   new_oldest)
+        else:
+            result = await self._resolve_sync(req, wb, n_txns, new_oldest)
+        self.conflict_batches += 1
+        self._account_batch(req, wb, n_txns)
+        self._retain_state(req)
+        n_conflict = sum(1 for s in result.statuses if s != 0)
+        self.conflict_transactions += n_conflict
+        TraceEvent("ResolverBatch").detail("Version", req.version).detail(
+            "Transactions", n_txns
+        ).detail("Conflicts", n_conflict).log()
+        # Catch-up payload for the requesting proxy: committed system
+        # mutations from windows it has not yet seen (in-process reply
+        # attribute; the wire tier will lift this into the reply message
+        # when proxies span processes).
+        result.state_mutations = self.recent_state(
+            req.last_receive_version, req.prev_version
+        )
+        return result
+
+    def _batch_for_cs(self, req, wb, *, wants_wire: bool):
+        """The batch in the form this backend consumes: device backends
+        take the columnar WireBatch straight into the vectorized packer;
+        object backends get the decoded (or original) txn list."""
+        if wb is not None and wants_wire:
+            return wb
+        if req.transactions or wb is None:
+            return req.transactions
+        return wb.to_txns()
+
+    async def _resolve_sync(self, req, wb, n_txns, new_oldest):
+        """The synchronous path (object backends, or TPU_PIPELINE_DEPTH
+        <= 1): resolve end to end, then advance both chains."""
+        batch = self._batch_for_cs(
+            req, wb, wants_wire=hasattr(self.cs, "submit")
+        )
         try:
-            result = self.cs.resolve(req.version, new_oldest, req.transactions)
+            result = self.cs.resolve(req.version, new_oldest, batch)
         except BaseException as e:
             # A failed batch commits NOTHING (no write merged, every client
             # answered with an error by the proxy), so advancing the version
@@ -140,35 +298,65 @@ class ResolverRole:
                 "Version", req.version
             ).error(e).log()
             self.version.set(req.version)
+            if self._consumed.get() == req.prev_version:
+                self._consumed.set(req.version)
             raise
-        self.conflict_batches += 1
-        self.total_transactions += len(req.transactions)
-        for t in req.transactions:
-            self.keys_resolved += len(t.read_ranges) + len(t.write_ranges)
-            for w in t.write_ranges:
-                self._sample_key(w.begin)
-        # Retain this window's system mutations until the proxy reports
-        # the merged verdicts (apply_feedback), then prune the write-life
-        # horizon.
-        sys_muts = getattr(req, "system_mutations", ())
-        if sys_muts:
-            self._pending_state[req.version] = list(sys_muts)
-        horizon = req.version - SERVER_KNOBS.MAX_WRITE_TRANSACTION_LIFE_VERSIONS
-        for v in [v for v in self.state_store if v < horizon]:
-            del self.state_store[v]
-        for v in [v for v in self._pending_state if v < horizon]:
-            del self._pending_state[v]
-        n_conflict = sum(1 for s in result.statuses if s != 0)
-        self.conflict_transactions += n_conflict
-        TraceEvent("ResolverBatch").detail("Version", req.version).detail(
-            "Transactions", len(req.transactions)
-        ).detail("Conflicts", n_conflict).log()
         self.version.set(req.version)
-        # Catch-up payload for the requesting proxy: committed system
-        # mutations from windows it has not yet seen (in-process reply
-        # attribute; the wire tier will lift this into the reply message
-        # when proxies span processes).
-        result.state_mutations = self.recent_state(
-            req.last_receive_version, req.prev_version
-        )
+        if self._consumed.get() == req.prev_version:
+            self._consumed.set(req.version)
         return result
+
+    async def _resolve_pipelined(self, req, wb, n_txns, new_oldest):
+        """Dispatch under the version chain, consume under the _consumed
+        chain (see module docstring). The depth bound parks the dispatch
+        until enough older verdicts were consumed."""
+        depth = max(1, SERVER_KNOBS.TPU_PIPELINE_DEPTH)
+        if len(self._inflight_q) >= depth:
+            # Ascending in-flight versions; consuming through the
+            # (len-depth)-th leaves depth-1 in flight. Older windows'
+            # consumption never needs this coroutine, so parking here
+            # cannot deadlock the chain.
+            target = self._inflight_q[len(self._inflight_q) - depth]
+            await self._consumed.when_at_least(target)
+        batch = self._batch_for_cs(req, wb, wants_wire=True)
+        try:
+            handle = self.cs.submit(req.version, new_oldest, batch)
+        except BaseException as e:
+            TraceEvent("ResolverBatchError", severity=40).detail(
+                "Version", req.version
+            ).error(e).log()
+            self.version.set(req.version)
+            # Keep the consumption chain intact for successor windows.
+            await self._consumed.when_at_least(req.prev_version)
+            if self._consumed.get() == req.prev_version:
+                self._consumed.set(req.version)
+            raise
+        self._inflight_q.append(req.version)
+        self.max_inflight = max(self.max_inflight, len(self._inflight_q))
+        # Unblock the NEXT window's dispatch: device state is ordered by
+        # the dispatch sequence, so the chain may advance before verdicts
+        # are read back.
+        self.version.set(req.version)
+        # Yield before blocking on verdicts: successor windows just made
+        # runnable by the version bump must get their dispatch enqueued
+        # FIRST — the readback below blocks the host, and batches overlap
+        # on device only if their dispatches precede it.
+        from ..core.runtime import TaskPriority, current_loop
+
+        await current_loop().yield_(TaskPriority.RESOLVER)
+        await self._consumed.when_at_least(req.prev_version)
+        try:
+            statuses = self.cs.verdicts(handle)
+        except BaseException as e:
+            TraceEvent("ResolverBatchError", severity=40).detail(
+                "Version", req.version
+            ).error(e).log()
+            if self._inflight_q and self._inflight_q[0] == req.version:
+                self._inflight_q.popleft()
+            self._consumed.set(req.version)
+            raise
+        if self._inflight_q and self._inflight_q[0] == req.version:
+            self._inflight_q.popleft()
+        self._consumed.set(req.version)
+        self._record_stages(handle)
+        return ConflictBatchResult(statuses)

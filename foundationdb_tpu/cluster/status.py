@@ -47,6 +47,24 @@ def _base_status(master, proxy) -> dict[str, Any]:
     }
 
 
+def _resolver_role_status(resolver, idx: int | None = None) -> dict[str, Any]:
+    """One resolver's status block, shared by both tiers: counters plus
+    the per-stage pipeline timing breakdown (ResolverRole.pipeline_status)."""
+    d: dict[str, Any] = {
+        "role": "resolver",
+        "version": resolver.version.get(),
+        "conflict_batches": resolver.conflict_batches,
+        "total_transactions": resolver.total_transactions,
+        "conflict_transactions": resolver.conflict_transactions,
+        "conflict_set": type(resolver.cs).__name__,
+    }
+    if idx is not None:
+        d["id"] = idx
+    if hasattr(resolver, "pipeline_status"):
+        d["pipeline"] = resolver.pipeline_status()
+    return d
+
+
 def _sharded_status(cluster) -> dict[str, Any]:
     """Status for the sharded/replicated tier: per-server storage roles,
     per-log queues, the shard map, DD progress, and replicated config
@@ -69,6 +87,15 @@ def _sharded_status(cluster) -> dict[str, Any]:
             "txns_too_old": proxy.txns_too_old,
         },
     ]
+    # Resolver fleet with the pipeline observability block: per-stage
+    # pack/h2d/device/d2h p50+p99 and the live/measured in-flight depth —
+    # the ROADMAP bar "h2d+pack < 20% of batch latency" read off a
+    # running cluster instead of a bench.
+    for i, r in enumerate(getattr(cluster, "resolvers", None)
+                          or [cluster.resolver]):
+        if not hasattr(r, "conflict_batches"):
+            continue  # remote handle: stats live on the resolver host
+        roles.append(_resolver_role_status(r, idx=i))
     # Per-log-set roles: the serving set plus (two-region clusters) the
     # remote set, each log with its durable-version LAG behind the
     # highest version the set has received — the number an operator
@@ -181,14 +208,7 @@ def _local_status(cluster) -> dict[str, Any]:
             "txns_too_old": proxy.txns_too_old,
             "commit_batches_in_flight": len(proxy.commit_stream),
         },
-        {
-            "role": "resolver",
-            "version": resolver.version.get(),
-            "conflict_batches": resolver.conflict_batches,
-            "total_transactions": resolver.total_transactions,
-            "conflict_transactions": resolver.conflict_transactions,
-            "conflict_set": type(resolver.cs).__name__,
-        },
+        _resolver_role_status(resolver),
         {
             "role": "log",
             "version": tlog.version.get(),

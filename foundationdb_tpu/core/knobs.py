@@ -94,6 +94,23 @@ class ServerKnobs(Knobs):
         # binds a sane deployment; simulation randomizes it low to exercise
         # the fallback.
         init("TPU_MAX_TOUCHED_BLOCKS", 1 << 17, sim_random_range=(8, 64))
+        # Resolver pipeline (resolver/tpu.py submit/verdicts +
+        # cluster/resolver_role.py): how many batches may be in flight on
+        # the device before the role must consume the oldest verdicts.
+        # Depth 1 degenerates to the synchronous path; >1 overlaps the
+        # phase-1/2/3 device steps of batch N+1 with batch N's D2H verdict
+        # readback (ping-pong state via the donated fast-path buffers).
+        init("TPU_PIPELINE_DEPTH", 4, sim_random_range=(1, 4))
+        # Probe kernel for the block-sparse fast path's fence-directory +
+        # in-block binary searches: "xla" (gather probe, every backend) or
+        # "pallas" (one fused Mosaic kernel replacing the log-step gather
+        # chain; interpret-mode on non-TPU backends, see
+        # resolver/pallas_probe.py).
+        init("TPU_PROBE_KERNEL", "xla")
+        # Proxies ship resolve batches as columnar wire bytes
+        # (resolver/wire.py) alongside/instead of txn object lists, so the
+        # resolver-side pack is the vectorized np.frombuffer path.
+        init("RESOLVER_WIRE_BATCH", True)
         # Storage (ref: fdbserver/Knobs.cpp storage section)
         init("STORAGE_DURABILITY_LAG_VERSIONS", 5 * 1_000_000)
         init("STORAGE_COMMIT_INTERVAL", 0.5)

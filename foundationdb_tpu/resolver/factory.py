@@ -23,6 +23,27 @@ backends (statuses are bit-for-bit by the differential suite).
 
 from __future__ import annotations
 
+KNOWN_CONFLICT_SET_IMPLS = ("oracle", "native", "tpu")
+
+
+def validate_conflict_set_impl(name: str | None = None) -> str:
+    """Eager CONFLICT_SET_IMPL validation for startup/spec-parse sites
+    (server knob parse, multiprocess spec validation): a typo'd knob must
+    fail the process at configuration time with the known-impl list, not
+    deep inside the resolver host's recruitment path with an opaque
+    per-generation error."""
+    if name is None:
+        from ..core.knobs import SERVER_KNOBS
+
+        name = SERVER_KNOBS.CONFLICT_SET_IMPL
+    low = str(name).lower()
+    if low not in KNOWN_CONFLICT_SET_IMPLS:
+        raise ValueError(
+            f"unknown CONFLICT_SET_IMPL {name!r}; known implementations: "
+            + "|".join(KNOWN_CONFLICT_SET_IMPLS)
+        )
+    return low
+
 
 def make_conflict_set(init_version: int = 0, impl: str | None = None, **kw):
     """Construct the knob-selected conflict set at `init_version`.
@@ -37,9 +58,7 @@ def make_conflict_set(init_version: int = 0, impl: str | None = None, **kw):
     at construction/dispatch time, so sim knob randomization reaches it
     with no plumbing here.
     """
-    from ..core.knobs import SERVER_KNOBS
-
-    name = (impl or SERVER_KNOBS.CONFLICT_SET_IMPL).lower()
+    name = validate_conflict_set_impl(impl)
     if name == "tpu":
         from .tpu import ConflictSetTPU
 
@@ -57,10 +76,6 @@ def make_conflict_set(init_version: int = 0, impl: str | None = None, **kw):
             "FallingBackTo", "oracle"
         ).log()
         name = "oracle"
-    if name == "oracle":
-        from .cpu import ConflictSetCPU
+    from .cpu import ConflictSetCPU
 
-        return ConflictSetCPU(init_version)
-    raise ValueError(
-        f"unknown CONFLICT_SET_IMPL {name!r} (oracle|native|tpu)"
-    )
+    return ConflictSetCPU(init_version)

@@ -555,13 +555,52 @@ def pack_batch(
     (too_old_l, r_begin, r_end, r_txn, r_snap, w_begin, w_end, w_txn) = (
         flatten_batch(txns, oldest_version)
     )
+    words, lens = pack_keys(
+        r_end + w_end + w_begin + r_begin, n_words
+    )
+    snaps = (
+        np.fromiter(
+            (t.read_snapshot for t in txns), dtype=np.int64, count=n_txns
+        )
+        if n_txns else np.zeros(0, dtype=np.int64)
+    )
+    too_old = np.zeros(n_txns, dtype=bool)
+    if n_txns:
+        too_old[:] = too_old_l
+    return _pack_rows(
+        words, lens, len(r_begin), len(w_begin),
+        np.asarray(r_txn, dtype=np.int64), np.asarray(w_txn, dtype=np.int64),
+        snaps, too_old, n_txns, oldest_version, n_words, caps,
+    )
 
+
+def _pack_rows(
+    words: np.ndarray,
+    lens: np.ndarray,
+    nr: int,
+    nw: int,
+    r_txn: np.ndarray,
+    w_txn: np.ndarray,
+    snaps: np.ndarray,
+    too_old: np.ndarray,
+    n_txns: int,
+    oldest_version: int,
+    n_words: int,
+    caps: tuple | None,
+) -> PackedBatch:
+    """Sort and fuse pre-flattened rows into the PackedBatch. `words`/`lens`
+    hold the LIVE rows' packed keys in the fixed concatenation order
+    r_end ++ w_end ++ w_begin ++ r_begin; `r_txn`/`w_txn` are each live
+    row's txn index; `snaps`/`too_old` are per-txn. Shared tail of the
+    legacy object path (pack_batch, via flatten_batch's Python loop) and
+    the vectorized wire path (wire.pack_batch_wire) — both produce
+    bit-identical buffers because everything after flattening IS this one
+    function."""
     if caps is None:
         caps = (0, 0, 0, 0, 0)
     elif len(caps) == 3:
         caps = (*caps, 0, 0)
     min_r, min_w, min_t, min_er, min_ew = caps
-    nr, nw = len(r_begin), len(w_begin)
     R = next_bucket(max(nr, min_r))
     Wr = next_bucket(max(nw, min_w))
     T = next_bucket(max(n_txns, min_t))
@@ -576,9 +615,6 @@ def pack_batch(
     # their positions are assigned arithmetically below — sorting up to
     # 2x fewer rows on the commit critical path.
     P_act = 2 * nr + 2 * nw
-    words, lens = pack_keys(
-        r_end + w_end + w_begin + r_begin, n_words
-    )
     if lens.size and int(lens.max()) >= LEN_PAD:
         raise KeyWidthError(
             f"key length {int(lens.max())} exceeds the len-field limit"
@@ -596,17 +632,19 @@ def pack_batch(
     # byte order == the biased-int32 order the device uses), halving the
     # lexsort passes — int64 is fine on HOST, it is only the device that
     # lacks it.
-    lt = (lens.astype(np.int64) << 3) | tags.astype(np.int64)
+    lt = (lens << 3) | tags  # fits int32 (len <= 14 bits)
     raw = words.view(np.uint32) ^ np.uint32(0x80000000)
     pair_keys = []
     for j in range(0, n_words, 2):
-        hi = raw[:, j].astype(np.uint64) << np.uint64(32)
-        lo = (
-            raw[:, j + 1].astype(np.uint64)
-            if j + 1 < n_words
-            else np.uint64(0)
-        )
-        pair_keys.append(hi | lo)
+        # hi<<32 | lo without the u64 astype/shift/or chain: write the two
+        # u32 halves of a u64 buffer directly (little-endian: low word
+        # first) — half the memory passes of the arithmetic build.
+        pair = np.zeros(P_act, dtype="<u8")
+        pv = pair.view("<u4").reshape(P_act, 2)
+        pv[:, 1] = raw[:, j]
+        if j + 1 < n_words:
+            pv[:, 0] = raw[:, j + 1]
+        pair_keys.append(pair)
     order = _sort_order(pair_keys, lt, P_act)
     inv = np.empty(P_act, np.int32)
     inv[order] = np.arange(P_act, dtype=np.int32)
@@ -695,14 +733,11 @@ def pack_batch(
             "(chunk the batch; see SERVER_KNOBS.TPU_MAX_CHUNK_RANGES)"
         )
     too_old_arr = np.zeros(T, np.int64)
-    too_old_arr[:n_txns] = np.asarray(too_old_l, dtype=np.int64)
+    too_old_arr[:n_txns] = too_old.astype(np.int64)
     buf[lay.off_tmeta : lay.off_tmeta + T] = (
         rcount | (wcount << 15) | (too_old_arr << 30)
     ).astype(np.int32)
     if n_txns:
-        snaps = np.fromiter(
-            (t.read_snapshot for t in txns), dtype=np.int64, count=n_txns
-        )
         live_reads = (~too_old_arr[:n_txns].astype(bool)) & (rcount[:n_txns] > 0)
         rel = snaps - oldest_version
         if live_reads.any():

@@ -1,0 +1,146 @@
+"""Pallas probe kernel for the block-sparse fast path (knob-gated).
+
+WHY: the fast resolve's rank stage is logNB + logB *separate* row-gather
+dispatches (`tpu._fence_rank` + `tpu._block_probe`), and on the measured
+v5e the cost model is op count x a per-op floor (~1-4 ms per dispatched
+gather) — for NB=32K, B=32 that is ~20 gather ops of floor cost before
+any real compute. PAPER.md names Pallas as the design-basis tool for
+exactly this: ONE fused kernel runs the whole two-level probe (fence
+halving walk, in-block halving walk, equality test) per query tile, so
+the XLA generic-gather tax is paid once per resolve, not once per probe
+step.
+
+SHAPE: `probe_ranks` maps the three sorted-key operands to
+(bid, lb_loc, eq_loc) exactly as the XLA pair does — the kernel is a
+drop-in for the rank section of `tpu._resolve_block_kernel_impl`, and
+the rest of the resolve consumes its outputs unchanged, so verdicts are
+bit-identical by construction (asserted by tests/test_pipeline.py's
+probe parity test).
+
+GATING: SERVER_KNOBS.TPU_PROBE_KERNEL selects "xla" (default — every
+backend) or "pallas". The kernel holds the fence directory, the state
+matrix and one query tile in VMEM (grid over query tiles); state sizes
+past `_VMEM_BUDGET_BYTES` fall back to the XLA probe at trace time, so
+the knob can never OOM VMEM. On non-TPU backends the kernel runs in
+Pallas interpret mode — tier-1 (JAX_PLATFORMS=cpu) exercises the same
+kernel body the chip compiles. The in-kernel row gathers use jnp.take
+along the lane axis; on real chips Mosaic's lane-gather lowering is the
+deployment-validation item (the knob default stays "xla" until a
+real-chip BENCH flips it).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_VMEM_BUDGET_BYTES = 12 << 20  # fences + hkeys + tile operands, headroom
+_TILE_Q = 512                  # query columns per grid step
+
+
+def _take_cols(mat, idx):
+    """mat[:, idx] for a (W1, N) operand and (TQ,) indices — the one
+    primitive the probe repeats; kept as a helper so a Mosaic-specific
+    rewrite (one-hot matmul / DMA gather) swaps in at a single site."""
+    return jnp.take(mat, idx, axis=1)
+
+
+def _lex_lt_eq_cols(h, q):
+    """Lexicographic h < q and h == q over leading-axis word rows (the
+    in-kernel twin of tpu._lex_lt_eq, shapes (W1, TQ))."""
+    lt = jnp.zeros(h.shape[1:], dtype=bool)
+    eq = jnp.ones(h.shape[1:], dtype=bool)
+    for j in range(h.shape[0]):
+        lt = lt | (eq & (h[j] < q[j]))
+        eq = eq & (h[j] == q[j])
+    return lt, eq
+
+
+def _probe_kernel(fences_ref, hkeys_ref, q_ref, bid_ref, lb_ref, eq_ref,
+                  *, NB: int, B: int):
+    """One query tile: fence halving walk -> block id, then the in-block
+    halving walk confined to [bid*B, bid*B + B). Both walks are fully
+    unrolled (logNB + logB steps) over VMEM-resident operands — one
+    kernel dispatch instead of one XLA gather dispatch per step."""
+    i32 = jnp.int32
+    f = fences_ref[...]
+    h = hkeys_ref[...]
+    q = q_ref[...]
+    C = h.shape[1]
+    tq = q.shape[1]
+
+    # ---- fence rank: #fences < q, then -1 + equality (tpu._fence_rank) --
+    pos = jnp.zeros((tq,), dtype=i32)
+    s = NB // 2
+    while s >= 1:
+        g = _take_cols(f, pos + (s - 1))
+        lt, _ = _lex_lt_eq_cols(g, q)
+        pos = pos + jnp.where(lt, i32(s), i32(0))
+        s //= 2
+    _, feq = _lex_lt_eq_cols(_take_cols(f, jnp.clip(pos, 0, NB - 1)), q)
+    bid = pos + feq.astype(i32) - 1
+
+    # ---- in-block rank (tpu._block_probe) ----
+    start = jnp.clip(bid, 0, NB - 1) * B
+    bpos = jnp.zeros((tq,), dtype=i32)
+    s = B // 2
+    while s >= 1:
+        g = _take_cols(h, jnp.clip(start + bpos + (s - 1), 0, C - 1))
+        lt, _ = _lex_lt_eq_cols(g, q)
+        bpos = bpos + jnp.where(lt, i32(s), i32(0))
+        s //= 2
+    _, beq = _lex_lt_eq_cols(
+        _take_cols(h, jnp.clip(start + bpos, 0, C - 1)), q
+    )
+    bid_ref[...] = bid
+    lb_ref[...] = bpos
+    eq_ref[...] = beq.astype(i32)
+
+
+def probe_ranks(hkeys, fences, smat, *, NB: int, B: int):
+    """(bid, lb_loc, eq_loc) of every sorted endpoint — the fused Pallas
+    replacement for tpu._fence_rank + tpu._block_probe. Call only from
+    inside the jitted resolve (operands are tracers); tile the query axis,
+    pad to the tile, strip the pad."""
+    from jax.experimental import pallas as pl
+
+    W1, P2 = smat.shape
+    C = hkeys.shape[1]
+    tq = min(_TILE_Q, P2)
+    pad = (-P2) % tq
+    qp = (
+        jnp.pad(smat, ((0, 0), (0, pad)), constant_values=0)
+        if pad else smat
+    )
+    n_tiles = (P2 + pad) // tq
+    interpret = jax.default_backend() != "tpu"
+    kernel = functools.partial(_probe_kernel, NB=NB, B=B)
+    out_shape = [
+        jax.ShapeDtypeStruct((P2 + pad,), jnp.int32) for _ in range(3)
+    ]
+    grid = (n_tiles,)
+    bid, lb, eq = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((W1, NB), lambda i: (0, 0)),
+            pl.BlockSpec((W1, C), lambda i: (0, 0)),
+            pl.BlockSpec((W1, tq), lambda i: (0, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((tq,), lambda i: (i,)) for _ in range(3)
+        ],
+        out_shape=out_shape,
+        interpret=interpret,
+    )(fences, hkeys, qp)
+    return bid[:P2], lb[:P2], eq[:P2]
+
+
+def fits_vmem(n_words: int, NB: int, B: int) -> bool:
+    """Trace-time guard: the whole directory + state must sit in VMEM for
+    the fused kernel; bigger states stay on the XLA probe."""
+    W1 = n_words + 1
+    need = 4 * W1 * (NB + NB * B + 2 * _TILE_Q)
+    return need <= _VMEM_BUDGET_BYTES

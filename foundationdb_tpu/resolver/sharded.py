@@ -223,6 +223,9 @@ class ShardedConflictSetTPU:
         self._pending_mirror = None  # (fences_dev, counts_dev) after compact
         self._since_compact = 0
         self.last_p2_iters = None
+        # Pipeline gauges (submit/verdicts), mirroring ConflictSetTPU.
+        self.inflight = 0
+        self.max_inflight = 0
 
     def _shard_state(self, hmat, counts, fences, btree, n) -> None:
         from jax.sharding import PartitionSpec as P
@@ -363,7 +366,7 @@ class ShardedConflictSetTPU:
 
     # -- shard_map steps --
 
-    def _build_block_step(self, lay, K: int):
+    def _build_block_step(self, lay, K: int, probe: str = "xla"):
         import jax
         from jax import lax
         from jax.experimental.shard_map import shard_map
@@ -377,7 +380,7 @@ class ShardedConflictSetTPU:
         def body(hmat, counts, btree, fences, n, fused):
             h, c, bt, n_o, st = _resolve_block_kernel_impl(
                 hmat[0], counts[0], btree[0], fences[0], n[0], fused[0],
-                lay=lay, K=K, NB=NB, B=B,
+                lay=lay, K=K, NB=NB, B=B, probe=probe,
             )
             # Proxy-side verdict merge as an ICI collective: any shard's
             # CONFLICT/TOO_OLD wins (MasterProxyServer.actor.cpp:431-447).
@@ -432,17 +435,30 @@ class ShardedConflictSetTPU:
 
     # -- resolution --
 
-    def resolve(
+    def submit(
         self,
         version: int,
         new_oldest_version: int,
         txns: Sequence[TxnConflictInfo],
-    ) -> ConflictBatchResult:
+    ) -> "ShardedResolveHandle":
+        """Dispatch one batch across the mesh WITHOUT the verdict D2H:
+        clip/pack/rank on host, one shard_map step enqueued, handle
+        returned immediately — the mesh twin of ConflictSetTPU.submit, so
+        the resolver role overlaps batch N+1's host work and device step
+        with batch N's readback. Consume with verdicts() (the single
+        designated sync site)."""
         from jax.sharding import PartitionSpec as P
 
         from ..core.knobs import SERVER_KNOBS
-        from .tpu import _touched_blocks
+        from .tpu import _pc, _touched_blocks
+        from .wire import WireBatch
 
+        t_sub0 = _pc()
+        if isinstance(txns, WireBatch):
+            # The mesh path clips per shard on the host, which needs key
+            # objects; vectorized per-shard clipping of wire columns is
+            # the follow-up (ROADMAP) — decode once here.
+            txns = txns.to_txns()
         oldest_eff = max(self.oldest_version, new_oldest_version)
         if not (0 <= version - self._base < 2**31):
             raise ValueError(
@@ -570,6 +586,7 @@ class ShardedConflictSetTPU:
             step = self._steps.get(key)
             if step is None:
                 step = self._steps[key] = self._build_compact_step(lay, NB_out)
+            t_disp = _pc()
             out = step(self.hmat, self.counts, fused)
             (self.hmat, self.counts, self.btree, self.fences, self.n,
              st) = out
@@ -601,10 +618,16 @@ class ShardedConflictSetTPU:
                     buf2[lay.off_tsnap: lay.off_tsnap + lay.T] += delta
                 bufs.append(buf2)
             fused = self._put(np.stack(bufs), P(self.axis, None))
-            key = ("blk", lay.key(), K, self.NB, self.B)
+            from .tpu import _probe_impl_for
+
+            probe = _probe_impl_for(self.n_words, self.NB, self.B)
+            key = ("blk", lay.key(), K, self.NB, self.B, probe)
             step = self._steps.get(key)
             if step is None:
-                step = self._steps[key] = self._build_block_step(lay, K)
+                step = self._steps[key] = self._build_block_step(
+                    lay, K, probe
+                )
+            t_disp = _pc()
             out = step(self.hmat, self.counts, self.btree, self.fences,
                        self.n, fused)
             self.hmat, self.counts, self.btree, self.n, st = out
@@ -612,12 +635,74 @@ class ShardedConflictSetTPU:
                 self._fills[s, : len(self._fences_enc[s])] += inc_l[s]
             self._since_compact += 1
 
-        st_h = np.asarray(st)[0]
+        self.oldest_version = oldest_eff
+        self.inflight += 1
+        self.max_inflight = max(self.max_inflight, self.inflight)
+        t_end = _pc()
+        return ShardedResolveHandle(
+            st=st, lay=lay, n_txns=len(txns), version=version,
+            pack_ms=(t_disp - t_sub0) * 1e3,
+            dispatch_ms=(t_end - t_disp) * 1e3,
+            depth_at_submit=self.inflight,
+        )
+
+    def verdicts(self, handle: "ShardedResolveHandle") -> list[int]:
+        """Consume one in-flight mesh batch: the designated host-sync site
+        (the pmax-merged status vector's single D2H). Records the device
+        wait and readback split on the handle for the status pipeline
+        block."""
+        import jax
+
+        from .tpu import _pc
+
+        if handle.consumed:
+            raise RuntimeError("verdicts() consumed twice for one handle")
+        t0 = _pc()
+        jax.block_until_ready(handle.st)
+        t1 = _pc()
+        st_h = np.asarray(handle.st)[0]
+        t2 = _pc()
+        handle.device_ms = (t1 - t0) * 1e3
+        handle.d2h_ms = (t2 - t1) * 1e3
+        handle.consumed = True
+        self.inflight -= 1
+        lay = handle.lay
         if bool(st_h[lay.T + 4]):  # pragma: no cover - host bounds make this dead
             raise RuntimeError(
                 "sharded conflict set overflow despite the host headroom "
                 "bounds"
             )
         self.last_p2_iters = int(st_h[lay.T + 5])  # max across shards (pmax)
-        self.oldest_version = oldest_eff
-        return ConflictBatchResult([int(s) for s in st_h[: len(txns)]])
+        return [int(s) for s in st_h[: handle.n_txns]]
+
+    def resolve(
+        self,
+        version: int,
+        new_oldest_version: int,
+        txns: Sequence[TxnConflictInfo],
+    ) -> ConflictBatchResult:
+        """Synchronous resolve = submit + immediate verdicts."""
+        return ConflictBatchResult(
+            self.verdicts(self.submit(version, new_oldest_version, txns))
+        )
+
+
+class ShardedResolveHandle:
+    """One in-flight mesh batch (ShardedConflictSetTPU.submit): the
+    device-resident pmax-merged status vector + per-stage timings."""
+
+    __slots__ = ("st", "lay", "n_txns", "version", "pack_ms", "dispatch_ms",
+                 "device_ms", "d2h_ms", "depth_at_submit", "consumed")
+
+    def __init__(self, st, lay, n_txns: int, version: int, pack_ms: float,
+                 dispatch_ms: float, depth_at_submit: int):
+        self.st = st
+        self.lay = lay
+        self.n_txns = n_txns
+        self.version = version
+        self.pack_ms = pack_ms
+        self.dispatch_ms = dispatch_ms
+        self.device_ms = None
+        self.d2h_ms = None
+        self.depth_at_submit = depth_at_submit
+        self.consumed = False

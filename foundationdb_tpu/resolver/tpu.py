@@ -688,7 +688,8 @@ def _fence_rank(fences, qmat):
 
 
 def _resolve_block_kernel_impl(hmat, counts, btree, fences, n, fused, *,
-                               lay: FusedLayout, K: int, NB: int, B: int):
+                               lay: FusedLayout, K: int, NB: int, B: int,
+                               probe: str = "xla"):
     """Batch-scaled resolve over the block-sparse state: ranks against the
     fence directory + in-block probes, phase 1 via in-block gathers and the
     block-max segment tree, phase 2 shared with the dense kernel, phase 3 a
@@ -711,9 +712,18 @@ def _resolve_block_kernel_impl(hmat, counts, btree, fences, n, fused, *,
     hv = hmat[W + 1]
 
     # ---- block ranks for every sorted endpoint (logNB + logB probe) ----
-    bid = _fence_rank(fences, smat)                       # (P2,)
-    start = jnp.clip(bid, 0, NB - 1) * B
-    lb_loc, eq_loc = _block_probe(hkeys, smat, start, B)
+    if probe == "pallas":
+        # One fused Mosaic kernel for both walks (SERVER_KNOBS.
+        # TPU_PROBE_KERNEL=pallas; see resolver/pallas_probe.py) — same
+        # (bid, lb, eq) bit for bit, one dispatch instead of logNB+logB
+        # gather dispatches.
+        from .pallas_probe import probe_ranks
+
+        bid, lb_loc, eq_loc = probe_ranks(hkeys, fences, smat, NB=NB, B=B)
+    else:
+        bid = _fence_rank(fences, smat)                   # (P2,)
+        start = jnp.clip(bid, 0, NB - 1) * B
+        lb_loc, eq_loc = _block_probe(hkeys, smat, start, B)
     ub_loc = lb_loc + eq_loc                              # #block entries <= key
 
     # ============ Phase 1: read-vs-history ============
@@ -1066,8 +1076,30 @@ def _kernel_for(lay: FusedLayout):
     return fn
 
 
-def _block_kernel_for(lay: FusedLayout, K: int, NB: int, B: int):
-    key = ("blk", lay.key(), K, NB, B)
+def _probe_impl_for(n_words: int, NB: int, B: int) -> str:
+    """The probe implementation this dispatch compiles against:
+    SERVER_KNOBS.TPU_PROBE_KERNEL, downgraded to "xla" when the state
+    would not fit the Pallas kernel's VMEM budget (the knob must never be
+    able to OOM a grown conflict set)."""
+    from ..core.knobs import SERVER_KNOBS
+
+    impl = SERVER_KNOBS.TPU_PROBE_KERNEL
+    if impl == "pallas":
+        from .pallas_probe import fits_vmem
+
+        if not fits_vmem(n_words, NB, B):
+            return "xla"
+        return "pallas"
+    if impl != "xla":
+        raise ValueError(
+            f"unknown TPU_PROBE_KERNEL {impl!r} (xla|pallas)"
+        )
+    return "xla"
+
+
+def _block_kernel_for(lay: FusedLayout, K: int, NB: int, B: int,
+                      probe: str = "xla"):
+    key = ("blk", lay.key(), K, NB, B, probe)
     fn = _KERNEL_CACHE.get(key)
     if fn is None:
         # State buffers are donated: the touched-block scatter-back then
@@ -1078,7 +1110,7 @@ def _block_kernel_for(lay: FusedLayout, K: int, NB: int, B: int):
             lambda hmat, counts, btree, fences, n, fused:
             _resolve_block_kernel_impl(
                 hmat, counts, btree, fences, n, fused,
-                lay=lay, K=K, NB=NB, B=B,
+                lay=lay, K=K, NB=NB, B=B, probe=probe,
             ),
             donate_argnums=(0, 1, 2),
         )
@@ -1178,6 +1210,38 @@ def collect_results(handles: Sequence[PendingResolve]) -> list[np.ndarray]:
     return out
 
 
+def _pc() -> float:
+    """Stage-timing read for pipeline observability (status json per-stage
+    breakdown). Telemetry ONLY: no scheduling or protocol decision ever
+    reads these values, so sim replays stay seed-pure."""
+    import time
+
+    return time.perf_counter()  # fdblint: allow[det-wall-clock] -- stage telemetry only (pack/dispatch/device/d2h ms in status json); values never enter control flow, so sim replays stay seed-pure.
+
+
+class ResolveHandle:
+    """One submitted batch in flight (ConflictSetTPU.submit): the chunked
+    PendingResolves plus the per-stage timing the status pipeline block
+    reports. Consume exactly once with ConflictSetTPU.verdicts(); the
+    depth-bounding and reply ordering live in the caller (the resolver
+    role's commit-version chain)."""
+
+    __slots__ = ("chunks", "n_txns", "version", "pack_ms", "dispatch_ms",
+                 "device_ms", "d2h_ms", "depth_at_submit", "consumed")
+
+    def __init__(self, chunks, n_txns: int, version: int,
+                 pack_ms: float, dispatch_ms: float, depth_at_submit: int):
+        self.chunks = chunks          # [(chunk_n_txns, PendingResolve)]
+        self.n_txns = n_txns
+        self.version = version
+        self.pack_ms = pack_ms        # host: wire/object rows -> fused buf
+        self.dispatch_ms = dispatch_ms  # host rank + H2D/kernel enqueue
+        self.device_ms = None         # set at consumption
+        self.d2h_ms = None
+        self.depth_at_submit = depth_at_submit
+        self.consumed = False
+
+
 class ConflictSetTPU:
     """Device-resident BLOCK-SPARSE conflict set (ConflictSetCPU contract).
 
@@ -1269,6 +1333,12 @@ class ConflictSetTPU:
         self._result_seq = 0
         self._poisoned = False
         self.last_p2_iters = None  # phase-2 rounds of the last resulted batch
+        # Pipeline gauges (submit/verdicts): batches currently in flight on
+        # the device, and the high-water MEASURED depth — the number the
+        # pipeline smoke test and BENCH overlap legs assert on (configured
+        # depth is a knob; this is what actually overlapped).
+        self.inflight = 0
+        self.max_inflight = 0
 
     # -- introspection --
 
@@ -1471,7 +1541,10 @@ class ConflictSetTPU:
             buf2[lay.off_scalars + 1] = oldest_eff - self._base
             if delta:
                 buf2[lay.off_tsnap: lay.off_tsnap + lay.T] += delta
-            fn = _block_kernel_for(lay, K, self.NB, self.B)
+            fn = _block_kernel_for(
+                lay, K, self.NB, self.B,
+                probe=_probe_impl_for(self.n_words, self.NB, self.B),
+            )
             out = fn(self.hmat, self.counts, self.btree, self.fences,
                      self.n, buf2)
             self.hmat, self.counts, self.btree, self.n, st_aux = out
@@ -1526,44 +1599,116 @@ class ConflictSetTPU:
             out.append(cur)
         return out
 
+    def submit(self, version: int, new_oldest_version: int, batch
+               ) -> ResolveHandle:
+        """Dispatch one batch — txn objects OR a wire.WireBatch — without
+        any host-device sync: width admission, chunking and packing happen
+        here (vectorized end to end for wire batches), every chunk's H2D +
+        kernel is enqueued, and the handle returns immediately so the
+        caller can overlap the NEXT batch's pack/dispatch with this one's
+        device work. Consume with verdicts(); the version-ordering of
+        consumption is the caller's contract (cluster/resolver_role.py
+        chains it on the commit-version chain)."""
+        from ..core.knobs import SERVER_KNOBS
+        from .wire import WireBatch, chunk_bounds, pack_wire
+
+        if isinstance(batch, WireBatch):
+            longest = batch.max_key_len()
+            if longest > self.max_key_bytes:
+                self._grow_width(longest)
+            bounds = chunk_bounds(
+                batch, SERVER_KNOBS.TPU_MAX_CHUNK_TXNS,
+                SERVER_KNOBS.TPU_MAX_CHUNK_RANGES,
+            )
+            chunks = [
+                batch.slice(bounds[i], bounds[i + 1])
+                for i in range(len(bounds) - 1)
+            ] or [batch]
+            sizes = [c.n_txns for c in chunks]
+
+            def packer(ch):
+                return pack_wire(
+                    ch, self.oldest_version, self.n_words, self._sticky
+                )
+        else:
+            # Width admission/growth happens ONCE, up front, over the rows
+            # the packer will actually keep (same rules as flatten_batch:
+            # tooOld txns and empty ranges contribute nothing): a mid-batch
+            # width failure after some chunks already merged their writes
+            # would break the all-abort invariant the proxy's failure
+            # containment relies on (resolver_role.py: "a failed batch
+            # commits NOTHING"). A plain scan, no list materialization.
+            longest = 0
+            for t in batch:
+                if t.read_snapshot < self.oldest_version and t.read_ranges:
+                    continue
+                for r in t.read_ranges:
+                    if not r.is_empty():
+                        longest = max(longest, len(r.begin), len(r.end))
+                for w in t.write_ranges:
+                    if not w.is_empty():
+                        longest = max(longest, len(w.begin), len(w.end))
+            if longest > self.max_key_bytes:
+                self._grow_width(longest)
+            chunks = self._chunks(batch)
+            sizes = [len(c) for c in chunks]
+            packer = self.pack
+
+        pending = []
+        pack_ms = dispatch_ms = 0.0
+        for i, ch in enumerate(chunks):
+            tp = _pc()
+            pb = packer(ch)
+            td = _pc()
+            pack_ms += (td - tp) * 1e3
+            last = i == len(chunks) - 1
+            h = self.resolve_async(
+                version,
+                new_oldest_version if last else self.oldest_version,
+                pb,
+            )
+            dispatch_ms += (_pc() - td) * 1e3
+            pending.append((sizes[i], h))
+        self.inflight += 1
+        self.max_inflight = max(self.max_inflight, self.inflight)
+        return ResolveHandle(
+            pending, sum(sizes), version, pack_ms, dispatch_ms,
+            self.inflight,
+        )
+
+    def verdicts(self, handle: ResolveHandle) -> list[int]:
+        """Consume one in-flight batch: THE designated host-sync site of
+        the pipeline (fdblint's jax-pipeline-sync rule fences syncs on
+        in-flight handles to here and PendingResolve.result). Blocks until
+        the device finishes the batch, then one fused D2H brings every
+        chunk's statuses back."""
+        if handle.consumed:
+            raise RuntimeError("verdicts() consumed twice for one handle")
+        t0 = _pc()
+        jax.block_until_ready([h._st_aux for _, h in handle.chunks])
+        t1 = _pc()
+        sts = collect_results([h for _, h in handle.chunks])
+        t2 = _pc()
+        handle.device_ms = (t1 - t0) * 1e3
+        handle.d2h_ms = (t2 - t1) * 1e3
+        handle.consumed = True
+        self.inflight -= 1
+        out: list[int] = []
+        for st in sts:
+            out.extend(int(s) for s in st)
+        return out
+
     def resolve(
         self,
         version: int,
         new_oldest_version: int,
         txns: Sequence[TxnConflictInfo],
     ) -> ConflictBatchResult:
-        # Width admission/growth happens ONCE, up front, over the rows the
-        # packer will actually keep (same rules as flatten_batch: tooOld
-        # txns and empty ranges contribute nothing): a mid-batch width
-        # failure after some chunks already merged their writes would
-        # break the all-abort invariant the proxy's failure containment
-        # relies on (resolver_role.py: "a failed batch commits NOTHING").
-        # A plain scan, no list materialization — this is the hot path.
-        longest = 0
-        for t in txns:
-            if t.read_snapshot < self.oldest_version and t.read_ranges:
-                continue
-            for r in t.read_ranges:
-                if not r.is_empty():
-                    longest = max(longest, len(r.begin), len(r.end))
-            for w in t.write_ranges:
-                if not w.is_empty():
-                    longest = max(longest, len(w.begin), len(w.end))
-        if longest > self.max_key_bytes:
-            self._grow_width(longest)
-
-        statuses: list[int] = []
-        chunks = self._chunks(txns)
-        for i, chunk in enumerate(chunks):
-            batch = self.pack(chunk)
-            last = i == len(chunks) - 1
-            st = self.resolve_packed(
-                version,
-                new_oldest_version if last else self.oldest_version,
-                batch,
-            )
-            statuses.extend(int(s) for s in st)
-        return ConflictBatchResult(statuses)
+        """Synchronous resolve = submit + immediate verdicts (depth-1
+        pipeline). Accepts txn objects or a wire.WireBatch."""
+        return ConflictBatchResult(
+            self.verdicts(self.submit(version, new_oldest_version, txns))
+        )
 
     def warmup(self, shapes: Sequence[tuple[int, int, int]] | None = None,
                footprint: tuple[int, int] = (5, 2)) -> None:

@@ -44,6 +44,15 @@ def _apply_knobs(knob_args: list[str]) -> None:
                 )
         else:
             raise SystemExit(f"unknown knob {name}")
+    # Value-level validation of enum-shaped knobs, EAGERLY at startup: a
+    # typo'd --knob_conflict_set_impl must fail the process here with the
+    # known-impl list, not deep inside the resolver host's recruitment.
+    from .resolver.factory import validate_conflict_set_impl
+
+    try:
+        validate_conflict_set_impl()
+    except ValueError as e:
+        raise SystemExit(str(e))
 
 
 def _spec_from_file(path: str) -> dict:

@@ -60,6 +60,10 @@ _KNOB_RANGES = [
     ("LOG_PUSH_RETRIES", "server", (1, 4)),
     ("LOG_PUSH_RETRY_DELAY", "server", (0.01, 0.2)),
     ("LOG_ROUTER_RETRY_INTERVAL", "server", (0.02, 0.5)),
+    # r8: resolver pipeline depth — depth 1 pins the synchronous path,
+    # depth >1 runs the submit/verdicts overlap with its dual version
+    # chains (dispatch vs consumption) under the seed's chaos mix.
+    ("TPU_PIPELINE_DEPTH", "server", (1, 4)),
 ]
 
 # Categorical knob draws (same subset-randomization policy as the ranges).
@@ -71,6 +75,10 @@ _KNOB_RANGES = [
 # default so most seeds still exercise the native detector.
 _KNOB_CHOICES = [
     ("CONFLICT_SET_IMPL", "server", ("native", "native", "oracle", "tpu")),
+    # r8: proxies ship resolve batches as columnar wire bytes (or not) —
+    # both the vectorized wire pack and the legacy object path must
+    # produce seed-identical runs.
+    ("RESOLVER_WIRE_BATCH", "server", ("true", "false")),
 ]
 
 _REPLICATION_FOR = {3: ["single", "double", "triple"],

@@ -227,14 +227,17 @@ def test_key_width_growth_and_pipeline_survival():
             await db.set(b"x" * 40, b"v")
             assert cs.max_key_bytes >= 40
 
-            # Inject an internal resolver failure for exactly one batch.
-            real_resolve = cs.resolve
+            # Inject an internal resolver failure for exactly one batch
+            # (both resolve paths: the pipelined role dispatches via
+            # submit, the sync role via resolve).
+            real_resolve, real_submit = cs.resolve, cs.submit
 
             def boom(*a, **kw):
-                cs.resolve = real_resolve
+                cs.resolve, cs.submit = real_resolve, real_submit
                 raise RuntimeError("injected resolver failure")
 
             cs.resolve = boom
+            cs.submit = boom
             with pytest.raises(OperationFailed):
                 await db.set(b"victim", b"v")
             # ...but the pipeline is still alive and sound.

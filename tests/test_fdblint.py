@@ -328,6 +328,51 @@ def test_jax_host_sync_bad_in_traced_good_in_driver(tmp_path):
     assert [f.line for f in fs if not f.suppressed] == [6]
 
 
+def test_jax_pipeline_sync_bad(tmp_path):
+    """np.asarray / block_until_ready on an in-flight resolve handle
+    outside the designated consumption sites re-serializes the pipeline."""
+    fs = run_lint(tmp_path, {SIM: """
+        import numpy as np
+        import jax
+
+        def drive(cs, pb):
+            h = cs.resolve_async(1, 0, pb)
+            a = np.asarray(h._st_aux)        # sync mid-pipeline
+            jax.block_until_ready(h._st_aux)  # and again
+            return a
+
+        def drive2(cs, txns):
+            handle = cs.submit(1, 0, txns)
+            handle.st.block_until_ready()     # method-form sync
+            return handle
+    """})
+    assert rules_of(fs) == ["jax-pipeline-sync"]
+    assert len([f for f in fs if not f.suppressed]) == 3
+
+
+def test_jax_pipeline_sync_good_sites(tmp_path):
+    """The designated sites (verdicts/result/collect_results) may sync;
+    code outside foundationdb_tpu/ is out of scope."""
+    fs = run_lint(tmp_path, {SIM: """
+        import numpy as np
+
+        def verdicts(cs, pb):
+            h = cs.resolve_async(1, 0, pb)
+            return np.asarray(h._st_aux)
+
+        def result(cs, pb):
+            h = cs.submit(1, 0, pb)
+            return np.asarray(h.st)
+    """, "tools/helper.py": """
+        import numpy as np
+
+        def bench(cs, pb):
+            h = cs.resolve_async(1, 0, pb)
+            return np.asarray(h._st_aux)
+    """})
+    assert rules_of(fs) == []
+
+
 def test_jax_shard_map_body_reached(tmp_path):
     fs = run_lint(tmp_path, {"mod.py": """
         import jax

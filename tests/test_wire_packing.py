@@ -1,0 +1,200 @@
+"""Vectorized wire packing vs the legacy object loop — the differential
+the ISSUE-7 tentpole (b) pins: resolver/wire.py's pack_batch_wire must be
+BIT-identical to packing.pack_batch on every input, with the old loop kept
+as the oracle. Randomized key shapes cover empty keys, max-width keys,
+every end-derivation mode (keyAfter / integer increment / explicit), tooOld
+admission, empty-range drops, and the sticky-cap plumbing."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from foundationdb_tpu.kv.keys import KeyRange
+from foundationdb_tpu.resolver.packing import (
+    KeyWidthError,
+    StickyCaps,
+    pack_batch,
+)
+from foundationdb_tpu.resolver.types import TxnConflictInfo
+from foundationdb_tpu.resolver.wire import (
+    WireBatch,
+    chunk_bounds,
+    pack_batch_wire,
+    pack_wire,
+)
+
+
+def k8(x: int) -> bytes:
+    return struct.pack(">Q", int(x))
+
+
+def random_txns(rng, n, *, width=8, oldest=1000, key_space=1 << 20):
+    """Randomized batch exercising every admission/mode path: empty keys,
+    width-boundary keys, keyAfter ends, integer-increment ends, explicit
+    wide ends, EMPTY ranges (begin >= end), and snapshots straddling the
+    tooOld horizon."""
+    def rkey():
+        mode = rng.integers(0, 5)
+        if mode == 0:
+            return b""
+        if mode == 1:
+            return bytes(rng.integers(0, 256, width, dtype=np.uint8))  # max width
+        ln = int(rng.integers(1, width + 1))
+        return bytes(rng.integers(0, 256, ln, dtype=np.uint8))
+
+    def rrange():
+        mode = int(rng.integers(0, 5))
+        b = rkey()
+        if mode == 0:
+            return KeyRange(b, b + b"\x00") if len(b) < width else KeyRange(b, b)
+        if mode == 1 and len(b) == width:
+            # integer increment end (carry over the padded key space)
+            raw = int.from_bytes(b, "big")
+            if raw != (1 << (8 * width)) - 1:
+                return KeyRange(b, (raw + 1).to_bytes(width, "big"))
+        if mode == 2:
+            return KeyRange(b, b)  # EMPTY — must drop
+        return KeyRange(b, rkey())  # explicit (sometimes empty/reversed)
+
+    out = []
+    for _ in range(n):
+        snap = int(rng.integers(oldest - 500, oldest + 500))
+        rr = [rrange() for _ in range(int(rng.integers(0, 4)))]
+        wr = [rrange() for _ in range(int(rng.integers(0, 3)))]
+        out.append(TxnConflictInfo(snap, rr, wr))
+    return out
+
+
+def assert_packed_equal(a, b):
+    assert a.layout.key() == b.layout.key()
+    assert np.array_equal(a.buf, b.buf)
+    assert (a.n_txns, a.n_reads, a.n_writes, a.n_expl_r, a.n_expl_w) == (
+        b.n_txns, b.n_reads, b.n_writes, b.n_expl_r, b.n_expl_w
+    )
+    assert a.base == b.base
+    assert np.array_equal(a.wb_enc, b.wb_enc)
+    assert np.array_equal(a.we_enc, b.we_enc)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_wire_pack_differential_randomized(seed):
+    rng = np.random.default_rng(seed)
+    for trial in range(4):
+        txns = random_txns(rng, int(rng.integers(1, 120)))
+        oldest = 1000
+        wb = WireBatch.from_bytes(WireBatch.from_txns(txns).to_bytes())
+        a = pack_batch(txns, oldest, 2)
+        b = pack_batch_wire(wb, oldest, 2)
+        assert_packed_equal(a, b)
+
+
+def test_wire_pack_with_caps_and_sticky():
+    rng = np.random.default_rng(7)
+    txns = random_txns(rng, 50)
+    caps = (64, 64, 128, 16, 16)
+    a = pack_batch(txns, 1000, 2, caps=caps)
+    b = pack_batch_wire(WireBatch.from_txns(txns), 1000, 2, caps=caps)
+    assert_packed_equal(a, b)
+    # Sticky plumbing: pack_wire ratchets the same caps pack() would.
+    s1, s2 = StickyCaps(decay_batches=8), StickyCaps(decay_batches=8)
+    for _ in range(3):
+        txns = random_txns(rng, 40)
+        wb = WireBatch.from_txns(txns)
+        a = pack_batch(txns, 1000, 2, caps=s1.caps_for(len(txns)))
+        s1.update(a)
+        b = pack_wire(wb, 1000, 2, s2)
+        assert_packed_equal(a, b)
+
+
+def test_wire_roundtrip_and_decode():
+    rng = np.random.default_rng(11)
+    txns = random_txns(rng, 60)
+    wb = WireBatch.from_bytes(WireBatch.from_txns(txns).to_bytes())
+    back = wb.to_txns()
+    assert len(back) == len(txns)
+    for a, b in zip(txns, back):
+        assert a.read_snapshot == b.read_snapshot
+        assert list(a.read_ranges) == list(b.read_ranges)
+        assert list(a.write_ranges) == list(b.write_ranges)
+
+
+def test_wire_empty_batch():
+    wb = WireBatch.from_bytes(WireBatch.from_txns([]).to_bytes())
+    a = pack_batch([], 0, 2)
+    b = pack_batch_wire(wb, 0, 2)
+    assert_packed_equal(a, b)
+    assert wb.to_txns() == []
+
+
+def test_wire_fixed_width_fast_path_matches_gather():
+    """Uniform 8-byte keys ride the contiguous-slice fast path; a mixed
+    batch takes the gather — both must match the oracle."""
+    rng = np.random.default_rng(13)
+    uniform = [
+        TxnConflictInfo(
+            900, [KeyRange(k8(int(a)), k8(int(a) + 3))],
+            [KeyRange(k8(int(w)), k8(int(w) + 1))],
+        )
+        for a, w in zip(rng.integers(0, 1 << 20, 64),
+                        rng.integers(0, 1 << 20, 64))
+    ]
+    a = pack_batch(uniform, 1000, 2)
+    b = pack_batch_wire(WireBatch.from_txns(uniform), 1000, 2)
+    assert_packed_equal(a, b)
+
+
+def test_wire_key_width_error():
+    txns = [TxnConflictInfo(10, [], [KeyRange(b"x" * 20, b"y")])]
+    wb = WireBatch.from_txns(txns)
+    with pytest.raises(KeyWidthError):
+        pack_batch_wire(wb, 0, 2)
+    with pytest.raises(KeyWidthError):
+        pack_batch(txns, 0, 2)
+
+
+def test_chunk_bounds_caps():
+    rng = np.random.default_rng(17)
+    txns = random_txns(rng, 200)
+    wb = WireBatch.from_txns(txns)
+    bounds = chunk_bounds(wb, max_txns=64, max_ranges=100)
+    assert bounds[0] == 0 and bounds[-1] == wb.n_txns
+    ranges = (wb.r_counts + wb.w_counts).astype(np.int64)
+    for lo, hi in zip(bounds, bounds[1:]):
+        assert hi > lo
+        assert hi - lo <= 64
+        if hi - lo > 1:
+            assert int(ranges[lo:hi].sum()) <= 100
+    # Slices re-pack identically to packing the sliced objects.
+    lo, hi = bounds[0], bounds[1]
+    a = pack_batch(txns[lo:hi], 1000, 2)
+    b = pack_batch_wire(wb.slice(lo, hi), 1000, 2)
+    assert_packed_equal(a, b)
+
+
+def test_resolve_accepts_wire_batch():
+    """ConflictSetTPU.resolve/submit consume a WireBatch directly and the
+    verdicts equal the object path's (same oracle)."""
+    from foundationdb_tpu.resolver.cpu import ConflictSetCPU
+    from foundationdb_tpu.resolver.tpu import ConflictSetTPU
+
+    rng = np.random.default_rng(23)
+    cpu = ConflictSetCPU()
+    tpu = ConflictSetTPU(max_key_bytes=8, initial_capacity=64)
+    v = 1000
+    for b in range(3):
+        v += 100
+        txns = [
+            TxnConflictInfo(
+                v - int(rng.integers(0, 300)),
+                [KeyRange(k8(int(a)), k8(int(a) + 4))],
+                [KeyRange(k8(int(w)), k8(int(w) + 1))],
+            )
+            for a, w in zip(rng.integers(0, 500, 30),
+                            rng.integers(0, 500, 30))
+        ]
+        wb = WireBatch.from_bytes(WireBatch.from_txns(txns).to_bytes())
+        expected = cpu.resolve(v, v - 600, txns).statuses
+        got = tpu.resolve(v, v - 600, wb).statuses
+        assert got == expected
+    assert tpu.entries() == cpu.entries()

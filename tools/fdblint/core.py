@@ -46,6 +46,7 @@ RULES: dict[str, str] = {
     "jax-donated-reuse": "buffer read after being donated to a jit(donate_argnums=...) call",
     "jax-tracer-concrete": "Python bool()/int()/if/while/.item() on a tracer inside a jitted function",
     "jax-host-sync": "host sync (np.asarray, .block_until_ready) inside a jitted function",
+    "jax-pipeline-sync": "host sync (np.asarray, .block_until_ready) on an in-flight resolve handle outside the designated verdict-consumption sites",
     "knob-undeclared": "SERVER_KNOBS/CLIENT_KNOBS reference with no declaration in core/knobs.py",
     "knob-dead": "knob declared in core/knobs.py but referenced nowhere",
     "pragma": "malformed fdblint pragma (unknown rule id or missing '-- reason')",

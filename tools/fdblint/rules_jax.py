@@ -622,11 +622,90 @@ class _DonationScan:
 
 
 # ---------------------------------------------------------------------------
+# jax-pipeline-sync
+# ---------------------------------------------------------------------------
+#
+# The resolver pipeline's whole point is that NOTHING between a batch's
+# dispatch (resolve_async / submit) and its verdict consumption blocks on
+# the device: one stray np.asarray on an in-flight handle re-serializes
+# the pipeline and silently erases the overlap the depth knob configures.
+# Host syncs on handles are fenced into the designated consumption sites;
+# anywhere else in the package they are a finding.
+
+_PIPELINE_PRODUCERS = {"resolve_async", "submit"}
+# The designated consumption sites (function names): the handle/driver
+# boundary where the one host sync per batch belongs.
+_PIPELINE_SINKS = {"result", "_finish", "collect_results", "verdicts",
+                   "resolve_packed", "resolve"}
+_PIPELINE_SYNC_CALLS = {"numpy.asarray", "numpy.array",
+                        "jax.block_until_ready", "jax.device_get"}
+# Device arrays riding handles: syncing these is syncing the handle.
+_PIPELINE_HANDLE_ATTRS = {"_st_aux", "st"}
+
+
+def _pipeline_scan(ctx: FileCtx) -> list[Finding]:
+    if not ctx.path.startswith("foundationdb_tpu/"):
+        return []
+    findings: list[Finding] = []
+
+    def handle_tainted(expr: ast.AST, handles: set[str]) -> bool:
+        for nd in ast.walk(expr):
+            if isinstance(nd, ast.Name) and isinstance(nd.ctx, ast.Load) \
+                    and nd.id in handles:
+                return True
+            if isinstance(nd, ast.Attribute) \
+                    and nd.attr in _PIPELINE_HANDLE_ATTRS \
+                    and isinstance(nd.value, ast.Name) \
+                    and nd.value.id in handles:
+                return True
+        return False
+
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if fn.name in _PIPELINE_SINKS:
+            continue
+        handles: set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and isinstance(node.value.func, ast.Attribute) \
+                    and node.value.func.attr in _PIPELINE_PRODUCERS:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        handles.add(t.id)
+        if not handles:
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            loc = dict(end_line=node.end_lineno or node.lineno)
+            resolved = ctx.resolve(node.func)
+            if (resolved in _PIPELINE_SYNC_CALLS and node.args
+                    and handle_tainted(node.args[0], handles)):
+                findings.append(Finding(
+                    ctx.path, node.lineno, "jax-pipeline-sync",
+                    f"{resolved}() on an in-flight resolve handle in "
+                    f"{fn.name}(); host syncs on handles belong at the "
+                    "designated consumption sites (verdicts / "
+                    "PendingResolve.result / collect_results)", **loc))
+            elif (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "block_until_ready"
+                    and handle_tainted(node.func.value, handles)):
+                findings.append(Finding(
+                    ctx.path, node.lineno, "jax-pipeline-sync",
+                    ".block_until_ready() on an in-flight resolve handle "
+                    f"in {fn.name}(); consume via verdicts()/result() "
+                    "instead", **loc))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # pack entry points
 # ---------------------------------------------------------------------------
 
 def check(ctx: FileCtx) -> list[Finding]:
-    return []  # all three rules need the project-wide index
+    return _pipeline_scan(ctx)  # the three taint rules need the project index
 
 
 def check_project(ctxs: list[FileCtx]) -> list[Finding]:
