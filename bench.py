@@ -1201,6 +1201,271 @@ class _TmpPath:
         return self._b
 
 
+# ---------------------------------------------------------------------------
+# ISSUE 8: closed-loop open-client commit-plane bench
+# ---------------------------------------------------------------------------
+
+def _commit_plane_knobs() -> dict:
+    """Spec knobs of the bench cluster: the ISSUE's heavy-traffic commit
+    plane — pipelined proxy, GRV fast path, adaptive coalescing. Every
+    role host applies these from the shared cluster file."""
+    return {
+        "server:PROXY_PIPELINE_DEPTH": int(
+            os.environ.get("BENCH_CP_DEPTH", 4)),
+        "server:GRV_CACHE_STALENESS_MS": float(
+            os.environ.get("BENCH_CP_GRV_STALENESS_MS", 5.0)),
+        "server:COMMIT_TRANSACTION_BATCH_INTERVAL_MAX": 0.01,
+        "server:COMMIT_BATCH_BYTES_TARGET": 1 << 20,
+    }
+
+
+def run_commit_plane_child(cluster_file: str) -> None:
+    """One open-client worker process: N closed-loop async clients doing
+    GRV + Zipf(0.99) blind write + commit against the deployed cluster,
+    for a fixed wall duration. Prints one JSON line (commit/conflict/
+    error counts + subsampled grv/commit latencies, measured after the
+    warmup fence)."""
+    import numpy as np
+
+    n_clients = int(os.environ.get("BENCH_CP_CLIENTS", 32))
+    duration = float(os.environ.get("BENCH_CP_DURATION", 5.0))
+    warm = float(os.environ.get("BENCH_CP_WARM", 1.0))
+    key_space = int(os.environ.get("BENCH_CP_KEYSPACE", 16384))
+    seed = int(os.environ.get("BENCH_CP_SEED", 1))
+    wire = os.environ.get("BENCH_CP_WIRE", "1") == "1"
+
+    from foundationdb_tpu.core.knobs import CLIENT_KNOBS
+    from foundationdb_tpu.core.runtime import loop_context, spawn
+    from foundationdb_tpu.net.transport import real_loop_with_transport
+
+    CLIENT_KNOBS.COMMIT_WIRE_BATCH = wire
+    # Wider client flush window than the 0.5 ms default: a closed-loop
+    # worker with tens of in-flight commits coalesces them into real
+    # columnar batches (the 1-core container rewards fewer, fatter RPCs).
+    CLIENT_KNOBS.COMMIT_WIRE_BATCH_INTERVAL = float(
+        os.environ.get("BENCH_CP_FLUSH_MS", 2.0)) / 1e3
+    rng = np.random.default_rng(seed)
+    sample = zipf_sampler(key_space)
+    keys = sample(rng, 1 << 17).astype(np.int64)
+
+    loop, transport = real_loop_with_transport()
+    stats = {"commits": 0, "conflicts": 0, "errors": 0}
+    grv_lat: list = []
+    commit_lat: list = []
+
+    with loop_context(loop):
+        from foundationdb_tpu.cluster import multiprocess as mp
+
+        db = mp.connect(transport, cluster_file)
+
+        async def worker(wid: int):
+            from foundationdb_tpu.core.errors import (
+                CommitUnknownResult,
+                NotCommitted,
+                TransactionTooOld,
+            )
+
+            t_end = time.perf_counter() + duration
+            t_measure = t_end - duration + warm
+            i = wid
+            while time.perf_counter() < t_end:
+                k = int(keys[i % len(keys)])
+                i += n_clients
+                try:
+                    t0 = time.perf_counter()
+                    await db.conn.get_read_version()
+                    t1 = time.perf_counter()
+                    tr = db.create_transaction()
+                    tr.set(b"cp/%08d" % k, b"v%d" % i)
+                    await tr.commit()
+                    t2 = time.perf_counter()
+                except (NotCommitted, TransactionTooOld):
+                    if t0 >= t_measure:
+                        stats["conflicts"] += 1
+                    continue
+                except CommitUnknownResult:
+                    if t0 >= t_measure:
+                        stats["errors"] += 1
+                    continue
+                if t0 >= t_measure:
+                    stats["commits"] += 1
+                    if len(grv_lat) < 20000:
+                        grv_lat.append(t1 - t0)
+                        commit_lat.append(t2 - t1)
+
+        async def main():
+            from foundationdb_tpu.core.actors import all_of
+
+            tasks = [spawn(worker(w), name=f"cp{w}")
+                     for w in range(n_clients)]
+            await all_of([t.done for t in tasks])
+
+        loop.run(main(), timeout_sim_seconds=duration + 120)
+        transport.close()
+
+    out = dict(stats)
+    out["measure_s"] = duration - warm
+    out["n_clients"] = n_clients
+    out["grv_ms"] = [round(v * 1e3, 3) for v in grv_lat[::max(1, len(grv_lat) // 2000)]]
+    out["commit_ms"] = [round(v * 1e3, 3) for v in
+                        commit_lat[::max(1, len(commit_lat) // 2000)]]
+    print(json.dumps(out))
+
+
+def _commit_plane_status(cluster_file: str) -> dict:
+    """Pull the txn host's commit_pipeline block (TxnStatusRequest) — the
+    server-side per-stage grv/form/resolve/tlog attribution."""
+    from foundationdb_tpu.cluster.multiprocess import (
+        WLTOKEN_TXN_STATUS,
+        TxnStatusRequest,
+        read_cluster_file,
+    )
+    from foundationdb_tpu.core.runtime import loop_context
+    from foundationdb_tpu.net.transport import real_loop_with_transport
+
+    info = read_cluster_file(cluster_file) or {}
+    loop, transport = real_loop_with_transport()
+    with loop_context(loop):
+        async def main():
+            req = TxnStatusRequest()
+            transport.remote_stream(info["txn"], WLTOKEN_TXN_STATUS).send(req)
+            return await req.reply.future
+
+        st = loop.run(main(), timeout_sim_seconds=30)
+        transport.close()
+        return st
+
+
+def measure_commit_plane(seed: int) -> dict:
+    """ISSUE 8 acceptance leg: a real `server.py -r fdbd` 3-process
+    cluster (log/storage/txn over localhost TCP) under a ramp of
+    closed-loop open clients (Zipf 0.99 keys, GRV + blind write + commit
+    per iteration, spread over worker processes so the measuring side
+    scales past one Python loop). Per stage: sustained committed/s,
+    client-observed grv/commit p50+p99, and the txn host's server-side
+    stage breakdown; the ramp stops past the p99 knee. The depth-1
+    serial-plane differential is the fingerprint test
+    (tests/test_commit_plane.py::test_depth4_fingerprint_identical_to_depth1);
+    BENCH_r06's 200 commits/s serial leg is the 10x baseline."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    stages = [int(x) for x in os.environ.get(
+        "BENCH_CP_STAGES", "8,32,96,192,320").split(",")]
+    duration = float(os.environ.get("BENCH_CP_DURATION", 6.0))
+    per_proc = int(os.environ.get("BENCH_CP_PER_PROC", 64))
+
+    tdir = tempfile.mkdtemp(prefix="bench_cp_")
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tests"))
+    try:
+        from test_multiprocess import _launch, _teardown
+
+        cf, procs = _launch(
+            _TmpPath(tdir),
+            spec_extra={"knobs": _commit_plane_knobs(),
+                        "n_storage": 4, "n_logs": 2},
+        )
+        legs = []
+        try:
+            for n in stages:
+                n_procs = max(1, -(-n // per_proc))
+                per = -(-n // n_procs)
+                env = dict(
+                    os.environ,
+                    BENCH_CP_CLIENTS=str(per),
+                    BENCH_CP_DURATION=str(duration),
+                    BENCH_CP_SEED=str(seed),
+                )
+                children = [
+                    subprocess.Popen(
+                        [sys.executable, os.path.abspath(__file__),
+                         "--commit-plane-child", "--cluster-file", cf],
+                        env=dict(env, BENCH_CP_SEED=str(seed + 7 * j)),
+                        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                        text=True,
+                    )
+                    for j in range(n_procs)
+                ]
+                outs = []
+                for c in children:
+                    so, se = c.communicate(timeout=duration + 180)
+                    if c.returncode != 0:
+                        raise RuntimeError(
+                            f"commit-plane child rc={c.returncode}: "
+                            f"{se[-2000:]}"
+                        )
+                    outs.append(json.loads(so.strip().splitlines()[-1]))
+                commits = sum(o["commits"] for o in outs)
+                conflicts = sum(o["conflicts"] for o in outs)
+                errors = sum(o["errors"] for o in outs)
+                measure_s = outs[0]["measure_s"]
+                grv = np.array([v for o in outs for v in o["grv_ms"]])
+                cmt = np.array([v for o in outs for v in o["commit_ms"]])
+                leg = {
+                    "clients": n_procs * per,
+                    "worker_procs": n_procs,
+                    "commits_per_sec": round(commits / measure_s, 1),
+                    "conflicts_per_sec": round(conflicts / measure_s, 1),
+                    "errors": errors,
+                    "grv_p50_ms": round(float(np.percentile(grv, 50)), 2)
+                    if len(grv) else None,
+                    "grv_p99_ms": round(float(np.percentile(grv, 99)), 2)
+                    if len(grv) else None,
+                    "commit_p50_ms": round(float(np.percentile(cmt, 50)), 2)
+                    if len(cmt) else None,
+                    "commit_p99_ms": round(float(np.percentile(cmt, 99)), 2)
+                    if len(cmt) else None,
+                    "server_status": _commit_plane_status(cf),
+                }
+                legs.append(leg)
+                log(f"[commit-plane] {leg['clients']} clients: "
+                    f"{leg['commits_per_sec']:.0f} commits/s  "
+                    f"commit p50 {leg['commit_p50_ms']} p99 "
+                    f"{leg['commit_p99_ms']} ms  grv p50 "
+                    f"{leg['grv_p50_ms']} ms")
+                # Past the knee: throughput shrinking AND p99 blown out
+                # 3x past the lightest stage — later stages only melt the
+                # container further.
+                if (len(legs) >= 3
+                        and leg["commits_per_sec"]
+                        < 0.9 * legs[-2]["commits_per_sec"]
+                        and leg["commit_p99_ms"]
+                        and legs[0]["commit_p99_ms"]
+                        and leg["commit_p99_ms"]
+                        > 3 * legs[0]["commit_p99_ms"]):
+                    log("[commit-plane] past the p99 knee; stopping ramp")
+                    break
+        finally:
+            _teardown(procs)
+    finally:
+        shutil.rmtree(tdir, ignore_errors=True)
+
+    peak = max(legs, key=lambda s: s["commits_per_sec"])
+    baseline_r06 = 200.4  # BENCH_r06 multiprocess_commit commits_per_sec
+    knee = peak["clients"]
+    for prev, cur in zip(legs, legs[1:]):
+        if (cur["commits_per_sec"] < 1.05 * prev["commits_per_sec"]
+                or (cur["commit_p99_ms"] and prev["commit_p99_ms"]
+                    and cur["commit_p99_ms"] > 3 * prev["commit_p99_ms"])):
+            knee = cur["clients"]
+            break
+    return {
+        "knobs": _commit_plane_knobs(),
+        "stage_duration_s": duration,
+        "stages": legs,
+        "peak_commits_per_sec": peak["commits_per_sec"],
+        "peak_clients": peak["clients"],
+        "p99_knee_clients": knee,
+        "vs_bench_r06_commits_per_sec": round(
+            peak["commits_per_sec"] / baseline_r06, 1
+        ),
+        "target_2k_met": peak["commits_per_sec"] >= 2000.0,
+    }
+
+
 def measure_native_cpu(batch_txns: int, n_batches: int, key_space: int,
                        seed: int):
     """The reference-class native C++ baseline (native/conflict_set.cpp)
@@ -1360,6 +1625,16 @@ def main() -> None:
     ap.add_argument("--sharded-sweep-child", action="store_true",
                     help="internal: run the sharded sweep in THIS process "
                          "(device count already pinned) and print JSON")
+    ap.add_argument("--commit-plane", action="store_true",
+                    help="run ONLY the ISSUE-8 closed-loop commit-plane "
+                         "leg (real 3-process cluster, open-client ramp "
+                         "to the p99 knee) and write it to --bench-out")
+    ap.add_argument("--commit-plane-child", action="store_true",
+                    help="internal: one open-client worker process "
+                         "against --cluster-file; prints JSON")
+    ap.add_argument("--cluster-file", default=None,
+                    help="internal: cluster file of the commit-plane "
+                         "child's target deployment")
     ap.add_argument("--bench-out", default=os.environ.get(
                         "BENCH_OUT", "BENCH_r07.json"),
                     help="round artifact filename (relative to the repo "
@@ -1385,6 +1660,24 @@ def main() -> None:
     )
     sharded_batch = int(os.environ.get("BENCH_SHARDED_BATCH", 512))
     sharded_nshards = int(os.environ.get("BENCH_SHARDED_NSHARDS", 4))
+
+    if args.commit_plane_child:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        run_commit_plane_child(args.cluster_file)
+        return
+
+    if args.commit_plane:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        cp = measure_commit_plane(args.seed)
+        _write_bench({"commit_plane": cp}, args.bench_out)
+        print(json.dumps({
+            "metric": "commit_plane_commits_per_sec",
+            "value": cp["peak_commits_per_sec"],
+            "unit": "commits/s",
+            "vs_baseline": cp["vs_bench_r06_commits_per_sec"],
+            "detail": cp,
+        }))
+        return
 
     if args.pipeline_sweep:
         _enable_compile_cache()

@@ -40,6 +40,13 @@ class ClusterConnection:
         self.grv_endpoint = grv_endpoint
         self.commit_endpoint = commit_endpoint
         self.storage_endpoint = storage_endpoint
+        # Client-side GRV coalescing (ref: the reference client funnels
+        # concurrent getReadVersion calls through one batched request per
+        # proxy, NativeAPI readVersionBatcher): callers piggyback on the
+        # in-flight request of their priority — but only while it is
+        # UNANSWERED, so the served version is always read by the server
+        # after every joiner asked (external consistency holds).
+        self._grv_shared: dict = {}  # priority -> Promise
 
     async def _retrying(self, make_req, endpoint, request_timeout: float):
         """Idempotent request: re-send (a fresh request) on timeout OR
@@ -75,6 +82,29 @@ class ClusterConnection:
             )
 
     async def get_read_version(self, priority: int = 1) -> int:
+        if not CLIENT_KNOBS.GRV_COALESCE:
+            return await self._grv_fetch(priority)
+        shared = self._grv_shared.get(priority)
+        if shared is None or shared.future.is_set():
+            from ..core.runtime import Promise, spawn
+
+            shared = Promise()
+            self._grv_shared[priority] = shared
+
+            async def fetch(p=shared, prio=priority):
+                try:
+                    v = await self._grv_fetch(prio)
+                except BaseException as e:
+                    if not p.is_set():
+                        p.send_error(e)
+                    return
+                if not p.is_set():
+                    p.send(v)
+
+            spawn(fetch(), name="grvCoalesced")
+        return await shared.future
+
+    async def _grv_fetch(self, priority: int) -> int:
         return await self._retrying(
             lambda: GetReadVersionRequest(priority=priority),
             self.grv_endpoint, CLIENT_KNOBS.GRV_TIMEOUT,
@@ -132,10 +162,19 @@ class ShardedConnection(ClusterConnection):
 
     def __init__(self, grv_endpoint, commit_endpoint, location_endpoint,
                  storage_endpoints: dict, failure_monitor=None,
-                 failure_names: Optional[dict] = None):
+                 failure_names: Optional[dict] = None,
+                 commit_batch_endpoint=None):
         super().__init__(grv_endpoint, commit_endpoint,
                          storage_endpoint=None)
         self.location_endpoint = location_endpoint
+        # Commit wire batching (cluster/commit_wire.py): when the server
+        # publishes a batch endpoint (multiprocess txn host) and
+        # CLIENT_KNOBS.COMMIT_WIRE_BATCH is on, concurrent commits from
+        # this process coalesce into ONE columnar buffer per flush window
+        # instead of N pickled request objects.
+        self.commit_batch_endpoint = commit_batch_endpoint
+        self._commit_coalesce: Optional[list] = None
+        self._commit_flush_armed = False
         # Kept by REFERENCE: discovery (monitor_leader) updates the same
         # mapping in place when a recovery republishes endpoints.
         self.storage_endpoints = storage_endpoints
@@ -147,6 +186,104 @@ class ShardedConnection(ClusterConnection):
         from .load_balance import QueueModel
 
         self.queue_model = QueueModel()
+
+    # -- commit wire batching (cluster/commit_wire.py) --
+    async def commit(self, req: CommitTransactionRequest):
+        if (self.commit_batch_endpoint is None
+                or not CLIENT_KNOBS.COMMIT_WIRE_BATCH):
+            return await super().commit(req)
+        from ..core.errors import BrokenPromise, ConnectionFailed
+        from ..core.runtime import spawn
+
+        if self._commit_coalesce is None:
+            self._commit_coalesce = []
+        self._commit_coalesce.append(req)
+        if (len(self._commit_coalesce)
+                >= CLIENT_KNOBS.COMMIT_WIRE_BATCH_COUNT_MAX):
+            self._flush_commits()
+        elif not self._commit_flush_armed:
+            self._commit_flush_armed = True
+            spawn(self._commit_flush_timer(), name="commitFlushTimer")
+        # Same outcome semantics as the direct path: a lost reply is the
+        # defining maybe-committed ambiguity; server-reported outcomes
+        # (conflict, too_old, ...) surface as the same exceptions.
+        try:
+            result = await timeout(
+                req.reply.future, CLIENT_KNOBS.COMMIT_TIMEOUT, _LOST
+            )
+        except (ConnectionFailed, BrokenPromise) as e:
+            raise CommitUnknownResult(str(e))
+        if result is _LOST:
+            raise CommitUnknownResult()
+        return result
+
+    def _flush_commits(self) -> None:
+        reqs, self._commit_coalesce = self._commit_coalesce, []
+        if not reqs:
+            return
+        from ..core.runtime import spawn
+
+        spawn(self._ship_commit_batch(reqs), name="commitWireBatch")
+
+    async def _commit_flush_timer(self):
+        try:
+            await current_loop().delay(
+                CLIENT_KNOBS.COMMIT_WIRE_BATCH_INTERVAL
+            )
+        finally:
+            self._commit_flush_armed = False
+        self._flush_commits()
+
+    async def _ship_commit_batch(self, reqs) -> None:
+        """One columnar buffer for the whole flush window; per-txn
+        outcomes fan back onto each request's reply promise."""
+        from ..cluster.commit_wire import (
+            OUTCOME_COMMITTED,
+            OUTCOME_CONFLICT,
+            OUTCOME_MAYBE_COMMITTED,
+            OUTCOME_TOO_OLD,
+            CommitBatchRequest,
+            CommitWireBatch,
+            unpack_outcomes,
+        )
+        from ..cluster.interfaces import CommitID
+        from ..core.errors import (
+            BrokenPromise,
+            ConnectionFailed,
+            NotCommitted,
+            OperationFailed,
+            TransactionTooOld,
+        )
+
+        breq = CommitBatchRequest(CommitWireBatch.from_reqs(reqs).to_bytes())
+        self.commit_batch_endpoint.send(breq)
+        try:
+            outs = await timeout(
+                breq.reply.future, CLIENT_KNOBS.COMMIT_TIMEOUT, _LOST
+            )
+        except (ConnectionFailed, BrokenPromise):
+            outs = _LOST
+        if outs is not _LOST:
+            outs = unpack_outcomes(outs)
+        if outs is _LOST or len(outs) != len(reqs):
+            err = CommitUnknownResult("commit batch reply not received")
+            for r in reqs:
+                if not r.reply.is_set():
+                    r.reply.send_error(err)
+            return
+        for r, (code, version, stamp, msg) in zip(reqs, outs):
+            if r.reply.is_set():
+                continue
+            if code == OUTCOME_COMMITTED:
+                r.reply.send(CommitID(version, stamp))
+            elif code == OUTCOME_CONFLICT:
+                r.reply.send_error(NotCommitted(msg))
+            elif code == OUTCOME_TOO_OLD:
+                r.reply.send_error(TransactionTooOld(msg))
+            elif code == OUTCOME_MAYBE_COMMITTED:
+                r.reply.send_error(CommitUnknownResult(msg))
+            else:
+                r.reply.send_error(OperationFailed(msg))
 
     # -- location cache (ref: getKeyLocation/locationCache) --
     async def _locate(self, key: bytes) -> tuple[bytes, tuple]:

@@ -110,12 +110,19 @@ class WatchValueRequest:
 
 @dataclass
 class TLogCommitRequest:
-    """(ref: TLogCommitRequest, fdbserver/TLogInterface.h)."""
+    """(ref: TLogCommitRequest, fdbserver/TLogInterface.h).
+
+    `wire` optionally carries the mutation payload as ONE columnar buffer
+    (commit_wire.pack_tagged_mutations, SERVER_KNOBS.TLOG_WIRE_BATCH):
+    cross-process pushes ship it INSTEAD of the object list, so the
+    commit path never walks per-mutation dataclasses through the
+    recursive wire encoder."""
 
     prev_version: int
     version: int
     mutations: Sequence[Mutation]
     epoch: int = 0
+    wire: Optional[bytes] = None
     reply: Promise = field(default_factory=Promise)
 
 

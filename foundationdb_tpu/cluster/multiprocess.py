@@ -55,6 +55,8 @@ from .log_system import TaggedMutation
 
 # -- well-known tokens (extending net/service.py's client-facing trio) --
 WLTOKEN_LOCATION = 13
+WLTOKEN_COMMIT_BATCH = 14    # columnar CommitBatchRequest (commit_wire.py)
+WLTOKEN_TXN_STATUS = 15      # TxnStatusRequest: commit-plane status pull
 WLTOKEN_LOG_BASE = 100       # +2*i commit, +2*i+1 control
 WLTOKEN_STORAGE_BASE = 300   # +2*tag read, +2*tag+1 control
 WLTOKEN_RESOLVER_BASE = 500  # host control; +1+idx per-resolver resolve
@@ -187,14 +189,30 @@ class StorageStatusRequest:
     reply: Promise = field(default_factory=Promise)
 
 
+@dataclass
+class TxnStatusRequest:
+    """Operator/bench pull of the txn host's commit-plane status: the
+    proxy's `commit_pipeline` block (grv/form/resolve/tlog stage p50+p99,
+    in-flight commit-version depth, GRV cache hit split) over the wire —
+    how `bench.py --commit-plane` attributes its per-stage breakdown and
+    an attached shell reads the deployed proxy."""
+
+    reply: Promise = field(default_factory=Promise)
+
+
 for _cls in (
     TLogPeekRequest, TLogPopRequest, TLogLockRequest, TLogTruncateRequest,
     TLogSkipToRequest, TLogStatusRequest, TLogConfirmEpochRequest,
     TLogHostDurableRequest, StorageRollbackRequest, StorageStatusRequest,
-    TaggedMutation, InitResolversRequest, ResolverSkipWindowRequest,
-    ResolverStatusRequest, ResolveBatchReply,
+    TxnStatusRequest, TaggedMutation, InitResolversRequest,
+    ResolverSkipWindowRequest, ResolverStatusRequest, ResolveBatchReply,
 ):
     register_message(_cls)
+
+# Importing the module registers CommitBatchRequest with the wire codec —
+# the txn host must be able to DECODE a client's columnar commit batch
+# before any handler-local import runs.
+from .commit_wire import CommitBatchRequest  # noqa: E402,F401
 
 
 # -- cluster file: the deployment's single shared document --
@@ -364,8 +382,14 @@ class LogHost:
             ))
 
     async def _commit(self, log, req: TLogCommitRequest):
-        await log.commit(req.prev_version, req.version,
-                         list(req.mutations), epoch=req.epoch)
+        if getattr(req, "wire", None) is not None:
+            from .commit_wire import unpack_tagged_mutations
+
+            muts = unpack_tagged_mutations(req.wire)
+        else:
+            muts = list(req.mutations)
+        await log.commit(req.prev_version, req.version, muts,
+                         epoch=req.epoch)
         return None
 
     async def _control(self, log, req):
@@ -828,14 +852,24 @@ class RemoteLogSystem:
 
     async def push(self, prev_version: int, version: int,
                    tagged_mutations, epoch: int = 0) -> None:
+        from .commit_wire import pack_tagged_mutations
         from .log_system import route_batches
 
         per_log = route_batches(tagged_mutations, self.n_logs,
                                 self.replica_set_for_tag)
+        wire_on = bool(SERVER_KNOBS.TLOG_WIRE_BATCH)
         reqs = []
         for stream, batch in zip(self._commit, per_log):
-            req = TLogCommitRequest(prev_version, version, tuple(batch),
-                                    epoch=epoch)
+            if wire_on:
+                # Columnar push: one packed buffer per log instead of N
+                # TaggedMutation objects through the recursive encoder.
+                req = TLogCommitRequest(
+                    prev_version, version, (), epoch=epoch,
+                    wire=pack_tagged_mutations(tuple(batch)),
+                )
+            else:
+                req = TLogCommitRequest(prev_version, version,
+                                        tuple(batch), epoch=epoch)
             stream.send(req)
             reqs.append(req)
         got = await timeout(
@@ -1049,6 +1083,16 @@ class TxnHost:
         # host: a forwarder routes by key to the owning storage.
         self._read_fwd: PromiseStream = PromiseStream()
         transport.register_endpoint(self._read_fwd, WLTOKEN_READ)
+        # Columnar commit batches (commit_wire.CommitBatchRequest): one
+        # buffer of N client commits unpacked here and fed to the current
+        # generation's commit stream — the client->txn-host twin of the
+        # proxy->resolver wire path. Permanent endpoints (like the read
+        # forwarder): they outlive generations, routing through the refs.
+        self._commit_batch_s: PromiseStream = PromiseStream()
+        transport.register_endpoint(self._commit_batch_s,
+                                    WLTOKEN_COMMIT_BATCH)
+        self._status_s: PromiseStream = PromiseStream()
+        transport.register_endpoint(self._status_s, WLTOKEN_TXN_STATUS)
         self.master = None
         self.resolver = None
         self.proxy = None
@@ -1060,6 +1104,93 @@ class TxnHost:
             self._read_fwd, self._forward_read, TaskPriority.STORAGE,
             "readForwarder",
         ))
+        self._tasks.add(serve_requests(
+            self._commit_batch_s, self._serve_commit_batch,
+            TaskPriority.PROXY_COMMIT, "commitBatchForwarder",
+        ))
+        self._tasks.add(serve_requests(
+            self._status_s, self._serve_txn_status,
+            TaskPriority.DEFAULT, "txnStatus",
+        ))
+
+    # -- batched commits (columnar client->proxy hop) --
+    async def _serve_commit_batch(self, req):
+        """Unpack one CommitWireBatch into individual commit requests on
+        the current generation's stream and gather per-txn outcomes via
+        reply callbacks under ONE deadline (a timer per transaction would
+        be pure per-commit overhead; the proxy's reply chain hands the
+        outcomes back in commit-version order anyway). Replies the
+        pipeline never produces (mid-recovery drop) become
+        maybe-committed — the error the direct path's client timeout maps
+        to. The outcome vector ships packed (pack_outcomes), one bytes
+        value on the wire."""
+        from ..core.errors import (
+            CommitUnknownResult,
+            NotCommitted,
+            TransactionTooOld,
+        )
+        from ..core.knobs import CLIENT_KNOBS
+        from .commit_wire import (
+            OUTCOME_COMMITTED,
+            OUTCOME_CONFLICT,
+            OUTCOME_FAILED,
+            OUTCOME_MAYBE_COMMITTED,
+            OUTCOME_TOO_OLD,
+            CommitWireBatch,
+            pack_outcomes,
+        )
+
+        subs = CommitWireBatch.from_bytes(req.payload).to_reqs()
+        outs: list = [None] * len(subs)
+        done = Promise()
+        remaining = len(subs)
+
+        def on_reply(i):
+            def cb(f):
+                nonlocal remaining
+                err = f.error()
+                if err is None:
+                    cid = f.get()
+                    outs[i] = (OUTCOME_COMMITTED, cid.version,
+                               cid.versionstamp, "")
+                elif isinstance(err, NotCommitted):
+                    outs[i] = (OUTCOME_CONFLICT, 0, b"", str(err))
+                elif isinstance(err, TransactionTooOld):
+                    outs[i] = (OUTCOME_TOO_OLD, 0, b"", str(err))
+                elif isinstance(err, CommitUnknownResult):
+                    outs[i] = (OUTCOME_MAYBE_COMMITTED, 0, b"", str(err))
+                else:
+                    outs[i] = (OUTCOME_FAILED, 0, b"", str(err))
+                remaining -= 1
+                if remaining == 0 and not done.future.is_set():
+                    done.send(None)
+            return cb
+
+        for i, r in enumerate(subs):
+            r.reply.future.add_callback(on_reply(i))
+        for r in subs:
+            self.commit_ref.send(r)
+        if remaining:
+            await timeout(done.future, CLIENT_KNOBS.COMMIT_TIMEOUT, _LOST)
+        for i in range(len(outs)):
+            if outs[i] is None:
+                outs[i] = (OUTCOME_MAYBE_COMMITTED, 0, b"",
+                           "commit reply not received")
+        return pack_outcomes(outs)
+
+    async def _serve_txn_status(self, req):
+        p = self.proxy
+        return {
+            "generation": self.generation,
+            "recoveries_done": self.recoveries_done,
+            "proxy": None if p is None else {
+                "txns_committed": p.txns_committed,
+                "txns_conflicted": p.txns_conflicted,
+                "txns_too_old": p.txns_too_old,
+                "grvs_throttled": p._c_grv_throttled.total,
+                "commit_pipeline": p.commit_pipeline_status(),
+            },
+        }
 
     # -- read forwarding (by-key routing like the client's location cache) --
     async def _forward_read(self, req):
@@ -1391,6 +1522,9 @@ def connect(transport, cluster_file: str):
             )
             for tag in range(n_storage)
         },
+        commit_batch_endpoint=transport.remote_stream(
+            info["txn"], WLTOKEN_COMMIT_BATCH
+        ),
     )
     return Database(None, conn=conn)
 
@@ -1419,6 +1553,20 @@ def run_role_host(role_class: str, cluster_file: str, datadir: str,
     # across process restarts, so peers' cached addresses stay valid (the
     # reference pins fdbd listen addresses in its conf the same way).
     port = spec.get("ports", {}).get(role_class, port)
+    # Spec-carried knob overrides ("server:NAME"/"client:NAME" -> value,
+    # the sim tester's format): every role host applies the same set from
+    # the shared cluster file, so a deployment tunes its commit plane
+    # (pipeline depth, GRV cache, batch targets) in ONE document instead
+    # of per-process --knob flags that can diverge.
+    from ..core.knobs import CLIENT_KNOBS, SERVER_KNOBS
+
+    regs = {"server": SERVER_KNOBS, "client": CLIENT_KNOBS}
+    for key, value in (spec.get("knobs") or {}).items():
+        reg_name, _, name = key.partition(":")
+        if reg_name not in regs:
+            raise ValueError(f"spec knob key {key!r}: registry must be "
+                             "'server' or 'client'")
+        regs[reg_name].set_knob(name, str(value))
     # Per-process trace file (the reference's fdbd writes one per process):
     # operators and tests read role behavior from the datadir.
     from ..core.trace import TraceSink, set_global_sink

@@ -15,9 +15,39 @@ resolver chains on (prevVersion -> version) and the tlog chains durability
 the same way (the reference's latestLocalCommitBatchResolving/Logging
 NotifiedVersion pair, :352-417 — realized here by the same primitive).
 
+The pipeline is EXPLICIT and bounded (the commit-plane twin of PR 7's
+resolver pipelining, cluster/resolver_role.py): up to
+SERVER_KNOBS.PROXY_PIPELINE_DEPTH commit versions are simultaneously in
+flight across the proxy->resolver->tlog stages, governed by two chains —
+
+  window take  a batch draws its (prev, version] window only when fewer
+               than `depth` older windows await replies, so version
+               assignment order IS dispatch order and backlog is bounded;
+  _replied     a NotifiedVersion gating phase 5: replies (success AND
+               every failure path) release in commit-version order, so
+               clients observe exactly the serial path's reply semantics.
+
+Depth 1 degenerates to the strictly serial one-window-at-a-time plane.
+Per-stage wall (grv / batch form / resolve / tlog) rides ContinuousSample
+reservoirs surfaced as the `commit_pipeline` status-json block.
+
+Batch formation is ADAPTIVE: the batcher's deadline floats between the
+INTERVAL_MIN/MAX knobs on recent-fill feedback against
+COMMIT_BATCH_BYTES_TARGET (_AdaptiveBatchInterval; ref: the reference's
+dynamic commitBatchInterval, MasterProxyServer.actor.cpp:244-262) —
+underfull deadline-closed batches stretch the wait to coalesce more per
+batch, full batches shave it back toward MIN.
+
 GRV (getConsistentReadVersion, :925 transactionStarter): batches client
 requests on GRV_BATCH_INTERVAL and answers with the master's live committed
 version, so a read version can never precede a commit it was issued after.
+When SERVER_KNOBS.GRV_CACHE_STALENESS_MS > 0 the quorum-liveness probe is
+AMORTIZED across batches: a batch whose last successful confirm-epoch-live
+is younger than the staleness bound serves the live committed version
+without re-confirming (the fast path), bounding the stale-read window a
+partitioned deposed proxy could serve to the knob's value — orders of
+magnitude below any recovery — while heavy traffic pays one confirm per
+staleness window instead of one per batch.
 """
 
 from __future__ import annotations
@@ -50,6 +80,67 @@ def mutation_write_ranges(m: Mutation) -> KeyRange:
     if m.type == MutationType.CLEAR_RANGE:
         return KeyRange(m.param1, m.param2)
     return KeyRange(m.param1, key_after(m.param1))
+
+
+def commit_request_bytes(r: CommitTransactionRequest) -> int:
+    """Byte estimate of one commit request (mutations + conflict ranges)
+    — the batcher's bytes_of for COMMIT_BATCH_BYTES_TARGET coalescing."""
+    n = 64
+    for m in r.mutations:
+        n += 16 + len(m.param1) + len(m.param2)
+    for kr in r.read_conflict_ranges:
+        n += len(kr.begin) + len(kr.end)
+    for kr in r.write_conflict_ranges:
+        n += len(kr.begin) + len(kr.end)
+    return n
+
+
+class _AdaptiveBatchInterval:
+    """Floating commit-batch deadline (ref: the reference's dynamic
+    commitBatchInterval feedback, MasterProxyServer.actor.cpp:244-262 —
+    Ratekeeper-style control, not a fixed knob). Two signals:
+
+    - smoothed PIPELINE LATENCY of recent batches (window take -> replies
+      released): the deadline tracks LATENCY_FRACTION of it, so batch
+      formation never costs more than ~10% of what the pipeline itself
+      takes — light load keeps the wait near MIN, a loaded pipeline
+      affords (and rewards) more coalescing;
+    - smoothed FILL against the count/byte targets: batches that fill
+      before the deadline pin the wait at MIN — load forms full batches
+      without any coalescing delay (the byte/count triggers close them).
+
+    Clamped to [COMMIT_TRANSACTION_BATCH_INTERVAL_MIN, _MAX]."""
+
+    LATENCY_FRACTION = 0.1
+
+    def __init__(self):
+        self.value = float(SERVER_KNOBS.COMMIT_TRANSACTION_BATCH_INTERVAL_MIN)
+        self._fill = 0.0      # smoothed fill fraction of recent batches
+        self._lat = 0.0       # smoothed batch pipeline latency (s)
+
+    def _clamp(self, v: float) -> float:
+        lo = SERVER_KNOBS.COMMIT_TRANSACTION_BATCH_INTERVAL_MIN
+        hi = max(lo, SERVER_KNOBS.COMMIT_TRANSACTION_BATCH_INTERVAL_MAX)
+        return min(hi, max(lo, v))
+
+    def record_close(self, closed_by: str, n_txns: int, n_bytes: int) -> None:
+        fill = max(
+            n_txns / max(1, SERVER_KNOBS.COMMIT_TRANSACTION_BATCH_COUNT_MAX),
+            n_bytes / max(1, SERVER_KNOBS.COMMIT_BATCH_BYTES_TARGET),
+        )
+        if closed_by != "deadline":
+            fill = 1.0
+        self._fill = 0.75 * self._fill + 0.25 * min(1.0, fill)
+
+    def record_latency(self, batch_s: float) -> None:
+        self._lat = (0.8 * self._lat + 0.2 * batch_s) if self._lat \
+            else batch_s
+        target = self.LATENCY_FRACTION * self._lat
+        if self._fill > 0.75:
+            # Full batches: the count/byte triggers are doing the
+            # closing; any deadline slack only adds latency.
+            target = 0.0
+        self.value = self._clamp(target)
 
 
 class CommitProxy:
@@ -98,6 +189,28 @@ class CommitProxy:
         # Shard-location service (ref: readRequestServer :1036).
         self.location_stream: PromiseStream = PromiseStream()
         self._tasks = ActorCollection()
+        # Commit-plane pipeline state (see module docstring): ascending
+        # in-flight commit versions between window take and reply, the
+        # reply-order chain, and the per-stage timing reservoirs.
+        from collections import deque
+
+        from ..core.stats import ContinuousSample
+
+        self._commit_inflight: deque[int] = deque()
+        # The reply-order chain is GLOBAL (master.replied): with several
+        # proxies per generation a window's predecessor may belong to a
+        # sibling proxy, so gating on a proxy-local chain would deadlock.
+        # The in-flight window bound stays per proxy.
+        self._replied = master.replied
+        self.max_commit_inflight = 0
+        self.commit_stage_samples = {
+            k: ContinuousSample(256)
+            for k in ("grv_ms", "form_ms", "resolve_ms", "tlog_ms")
+        }
+        self._batch_interval = _AdaptiveBatchInterval()
+        # GRV fast path: loop time of the last SUCCESSFUL epoch confirm
+        # (None until one lands — the first batch always confirms).
+        self._grv_confirmed_at = None
         # Commit statistics, flushed periodically as TraceEvents (ref:
         # ProxyStats, flow/Stats.h:55 CounterCollection).
         from ..core.stats import CounterCollection
@@ -108,6 +221,7 @@ class CommitProxy:
         self._c_too_old = self.stats.counter("TxnsTooOld")
         self._c_grv = self.stats.counter("GRVsServed")
         self._c_grv_throttled = self.stats.counter("GRVsThrottled")
+        self._c_grv_cached = self.stats.counter("GRVsCachedFastPath")
 
     @property
     def txns_committed(self) -> int:
@@ -125,12 +239,12 @@ class CommitProxy:
         self._tasks.add(spawn(
             batcher(
                 self.commit_stream,
-                lambda b: spawn(
-                    self._commit_batch(b), TaskPriority.PROXY_COMMIT,
-                    name="commitBatch",
-                ),
-                interval=SERVER_KNOBS.COMMIT_TRANSACTION_BATCH_INTERVAL_MIN,
+                self._on_commit_batch,
+                interval=lambda: self._batch_interval.value,
                 max_count=SERVER_KNOBS.COMMIT_TRANSACTION_BATCH_COUNT_MAX,
+                max_bytes=SERVER_KNOBS.COMMIT_BATCH_BYTES_TARGET,
+                bytes_of=commit_request_bytes,
+                with_info=True,
             ),
             TaskPriority.PROXY_COMMIT, name="commitBatcher",
         ))
@@ -160,6 +274,38 @@ class CommitProxy:
         self.stats.stop_logging()
         self._tasks.cancel_all()
 
+    def _on_commit_batch(self, batch, info) -> None:
+        """Batch closed: feed the adaptive-interval controller, record the
+        formation stage, spawn the per-batch pipeline actor."""
+        self._batch_interval.record_close(info.closed_by, len(batch),
+                                          info.bytes)
+        self.commit_stage_samples["form_ms"].add_sample(info.open_s * 1e3)
+        self._tasks.add(spawn(
+            self._commit_batch(batch), TaskPriority.PROXY_COMMIT,
+            name="commitBatch",
+        ))
+
+    def commit_pipeline_status(self) -> dict:
+        """The commit plane's observability block (`status json` proxy
+        roles, both tiers — the commit-side mirror of PR 7's resolver
+        pipeline block): configured/live/measured in-flight depth plus
+        per-stage grv/form/resolve/tlog p50+p99."""
+        from ..core.stats import stage_percentiles
+
+        return {
+            "depth_configured": SERVER_KNOBS.PROXY_PIPELINE_DEPTH,
+            "in_flight": len(self._commit_inflight),
+            "max_in_flight_measured": self.max_commit_inflight,
+            "stages": stage_percentiles(self.commit_stage_samples),
+            "batch_interval_ms": round(self._batch_interval.value * 1e3, 3),
+            "grv_cache": {
+                "staleness_ms": SERVER_KNOBS.GRV_CACHE_STALENESS_MS,
+                "served_cached": self._c_grv_cached.total,
+                "served_confirmed": self._c_grv.total
+                - self._c_grv_cached.total,
+            },
+        }
+
     # -- GRV --
     async def _confirm_epoch_live(self) -> None:
         """Every GRV batch confirms this generation's log quorum is still
@@ -182,6 +328,8 @@ class CommitProxy:
     async def _answer_grv_batch(self, reqs: list[GetReadVersionRequest]) -> None:
         if getattr(self, "_epoch_dead", False):
             return  # deposed: clients time out and retry onto the successor
+        loop = current_loop()
+        t0 = loop.now()
         # Admission control: when the ratekeeper's budget is exhausted the
         # batch is deferred, not denied — GRVs simply start later, which is
         # exactly how the reference's transactionStarter applies the rate
@@ -199,16 +347,26 @@ class CommitProxy:
             if admitted < len(reqs):
                 deferred = reqs[admitted:]
                 reqs = reqs[:admitted]
-                self._c_grv_throttled.add(len(deferred))
+                # GRVsThrottled counts REQUESTS, once each: a request
+                # deferred across several refill windows is one throttled
+                # GRV, not one per deferral.
+                newly = [r for r in deferred
+                         if not getattr(r, "_grv_throttled", False)]
+                for r in newly:
+                    r._grv_throttled = True
+                self._c_grv_throttled.add(len(newly))
                 TraceEvent("ProxyGRVThrottled").detail(
                     "Count", len(deferred)
                 ).log()
 
                 async def requeue():
                     await current_loop().delay(0.05)
-                    for r in deferred:
+                    # FIFO: deferred requests rejoin the FRONT of the
+                    # stream in arrival order — requests that arrived
+                    # during the throttle wait must not overtake them.
+                    for r in reversed(deferred):
                         if not r.reply.is_set():
-                            self.grv_stream.send(r)
+                            self.grv_stream.unpop(r)
 
                 self._tasks.add(
                     spawn(requeue(), TaskPriority.GRV, name="grvThrottle")
@@ -225,31 +383,46 @@ class CommitProxy:
             # the conflict window clients actually experience.
             await current_loop().delay(0.05 * current_loop().random.random01())
         v = self.master.get_live_committed_version()
-        try:
-            await self._confirm_epoch_live()
-        except TLogStopped as e:
-            # PROVEN deposed (a log is fenced by a newer generation): latch
-            # dead. Answering would risk a stale read; clients time out,
-            # retry, and land on the successor via discovery.
-            self._epoch_dead = True
-            TraceEvent("ProxyEpochDead", severity=30).detail(
-                "Generation", self.generation
-            ).error(e).log()
-            return
-        except BaseException as e:
-            from ..core.errors import ActorCancelled
+        # GRV fast path: within the staleness bound of the last successful
+        # confirm, the quorum-liveness probe is amortized — the version
+        # still comes from the live committed cache, only the re-confirm
+        # is elided, so a served version can never exceed what this
+        # generation committed.
+        staleness = SERVER_KNOBS.GRV_CACHE_STALENESS_MS / 1e3
+        cached = (
+            staleness > 0.0
+            and self._grv_confirmed_at is not None
+            and loop.now() - self._grv_confirmed_at <= staleness
+        )
+        if cached:
+            self._c_grv_cached.add(len(reqs))
+        else:
+            try:
+                await self._confirm_epoch_live()
+            except TLogStopped as e:
+                # PROVEN deposed (a log is fenced by a newer generation):
+                # latch dead. Answering would risk a stale read; clients
+                # time out, retry, and land on the successor via discovery.
+                self._epoch_dead = True
+                TraceEvent("ProxyEpochDead", severity=30).detail(
+                    "Generation", self.generation
+                ).error(e).log()
+                return
+            except BaseException as e:
+                from ..core.errors import ActorCancelled
 
-            if isinstance(e, ActorCancelled):
-                raise
-            # Liveness UNPROVEN (e.g. one lost control RPC on a lossy
-            # link): drop this batch only — the next batch re-confirms,
-            # exactly the reference's per-batch stall-and-retry. No latch:
-            # a transient timeout must not permanently kill GRV service on
-            # a live generation.
-            TraceEvent("ProxyGRVEpochUnconfirmed", severity=20).detail(
-                "Generation", self.generation
-            ).error(e).log()
-            return
+                if isinstance(e, ActorCancelled):
+                    raise
+                # Liveness UNPROVEN (e.g. one lost control RPC on a lossy
+                # link): drop this batch only — the next batch re-confirms,
+                # exactly the reference's per-batch stall-and-retry. No
+                # latch: a transient timeout must not permanently kill GRV
+                # service on a live generation.
+                TraceEvent("ProxyGRVEpochUnconfirmed", severity=20).detail(
+                    "Generation", self.generation
+                ).error(e).log()
+                return
+            self._grv_confirmed_at = loop.now()
         TraceEvent("ProxyGRV").detail("Version", v).detail(
             "Count", len(reqs)
         ).log()
@@ -257,15 +430,38 @@ class CommitProxy:
             if not r.reply.is_set():
                 self._c_grv.add(1)
                 r.reply.send(v)
+        self.commit_stage_samples["grv_ms"].add_sample(
+            (loop.now() - t0) * 1e3
+        )
 
     # -- commit pipeline --
     async def _commit_batch(self, reqs: list[CommitTransactionRequest]):
+        # Depth gate (the commit-plane twin of the resolver's in-flight
+        # bound): a batch draws its version window only when fewer than
+        # PROXY_PIPELINE_DEPTH older windows still await replies. Parking
+        # BEFORE the window take keeps version order == dispatch order and
+        # bounds the proxy-side backlog; older windows' replies never need
+        # this coroutine, so the wait cannot deadlock the chain. The
+        # while re-checks because several parked batches can wake on one
+        # reply and must not overshoot the bound together.
+        depth = max(1, SERVER_KNOBS.PROXY_PIPELINE_DEPTH)
+        while len(self._commit_inflight) >= depth:
+            target = self._commit_inflight[len(self._commit_inflight) - depth]
+            await self._replied.when_at_least(target)
         # Phase 1: version window (master is the version authority). Taken
         # OUTSIDE the try so the failure path can still drive this window
         # through the tlog chain.
         prev_version, version = self.master.get_commit_version()
+        self._commit_inflight.append(version)
+        self.max_commit_inflight = max(
+            self.max_commit_inflight, len(self._commit_inflight)
+        )
+        t_start = current_loop().now()
         try:
             await self._commit_batch_impl(reqs, prev_version, version)
+            self._batch_interval.record_latency(
+                current_loop().now() - t_start
+            )
         except GeneratorExit:
             # Interpreter GC of a parked coroutine (a dead generation's
             # batch collected during a LATER simulation run): not a
@@ -273,6 +469,14 @@ class CommitProxy:
             # run's SevError count across run_spec boundaries.
             raise
         except BaseException as e:
+            from ..core.errors import ActorCancelled
+
+            if isinstance(e, ActorCancelled):
+                # Generation teardown (proxy.stop cancels the tracked
+                # batch actors, incl. ones parked at the depth gate): the
+                # whole pipeline dies with the proxy — clients time out
+                # and retry onto the successor; no compensation to run.
+                raise
             # A wedged batch must never strand its clients or the batches
             # behind it. Nothing in this batch was reported committed, so
             # conservative all-abort semantics stay sound — but BOTH
@@ -331,9 +535,24 @@ class CommitProxy:
                 err = CommitUnknownResult(str(e))
             else:
                 err = OperationFailed(str(e))
+            # Failure replies honor the reply chain too: clients observe
+            # every window's outcome in commit-version order, and the
+            # chain ALWAYS advances so successor windows never wedge
+            # behind a failed one.
+            await self._replied.when_at_least(prev_version)
             for r in reqs:
                 if not r.reply.is_set():
                     r.reply.send_error(err)
+            self._advance_replied(version)
+
+    def _advance_replied(self, version: int) -> None:
+        """Release the reply chain past `version` and retire its in-flight
+        window (called with the chain at the window's prev_version — every
+        reply path gates on when_at_least(prev_version) first)."""
+        if self._commit_inflight and self._commit_inflight[0] == version:
+            self._commit_inflight.popleft()
+        if self._replied.get() < version:
+            self._replied.set(version)
 
     def _wire_on(self) -> bool:
         return bool(SERVER_KNOBS.RESOLVER_WIRE_BATCH)
@@ -524,6 +743,7 @@ class CommitProxy:
                     r.write_conflict_ranges = ()
 
         # Phase 2: resolution.
+        t_resolve = loop.now()
         txns = [
             TxnConflictInfo(
                 read_snapshot=r.read_snapshot,
@@ -563,6 +783,10 @@ class CommitProxy:
             )
             result = await self.resolver.resolve_batch(resolve_req)
 
+        self.commit_stage_samples["resolve_ms"].add_sample(
+            (loop.now() - t_resolve) * 1e3
+        )
+
         # Phase 3: merge verdicts, build the log payload; interpret
         # committed system-keyspace mutations (ApplyMetadataMutation).
         # Applied PRE-push like the reference's proxy-side
@@ -591,10 +815,19 @@ class CommitProxy:
             await loop.delay(0.05 * loop.random.random01())
 
         # Phase 4: make the batch durable in version order.
+        t_tlog = loop.now()
         await self._tlog_commit(prev_version, version, mutations)
+        self.commit_stage_samples["tlog_ms"].add_sample(
+            (loop.now() - t_tlog) * 1e3
+        )
 
-        # Phase 5: advance committed version, answer clients.
+        # Phase 5: advance committed version, answer clients — in
+        # commit-version order (the _replied chain): with up to
+        # PROXY_PIPELINE_DEPTH windows in flight, a younger window whose
+        # tlog push finished first must still reply after its elders, so
+        # clients observe exactly the serial plane's reply semantics.
         self.master.report_committed(version)
+        await self._replied.when_at_least(prev_version)
         for idx, (r, status) in enumerate(zip(reqs, result.statuses)):
             if r.reply.is_set():
                 continue
@@ -607,3 +840,4 @@ class CommitProxy:
             else:
                 self._c_conflicted.add(1)
                 r.reply.send_error(NotCommitted())
+        self._advance_replied(version)

@@ -129,19 +129,13 @@ class ResolverRole:
         """Per-stage timing breakdown + live depth for `status json`: the
         observable form of the ROADMAP bar "h2d+pack < 20% of batch
         latency" on a running cluster."""
-        def pct(s, q):
-            v = s.percentile(q)
-            return round(v, 3) if v is not None else None
+        from ..core.stats import stage_percentiles
 
         return {
             "depth_configured": SERVER_KNOBS.TPU_PIPELINE_DEPTH,
             "in_flight": len(self._inflight_q),
             "max_in_flight_measured": self.max_inflight,
-            "stages": {
-                k: {"p50": pct(s, 0.5), "p99": pct(s, 0.99),
-                    "samples": s.population}
-                for k, s in self.stage_samples.items()
-            },
+            "stages": stage_percentiles(self.stage_samples),
         }
 
     def _record_stages(self, handle) -> None:

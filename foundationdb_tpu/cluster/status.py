@@ -47,6 +47,23 @@ def _base_status(master, proxy) -> dict[str, Any]:
     }
 
 
+def _proxy_role_status(proxy) -> dict[str, Any]:
+    """One proxy's status block, shared by both tiers: commit counters
+    plus the commit-plane pipeline breakdown (CommitProxy.
+    commit_pipeline_status — grv/form/resolve/tlog stage p50+p99 and the
+    live/measured in-flight commit-version depth, mirroring the resolver
+    block PR 7 added)."""
+    d: dict[str, Any] = {
+        "role": "proxy",
+        "txns_committed": proxy.txns_committed,
+        "txns_conflicted": proxy.txns_conflicted,
+        "txns_too_old": proxy.txns_too_old,
+    }
+    if hasattr(proxy, "commit_pipeline_status"):
+        d["commit_pipeline"] = proxy.commit_pipeline_status()
+    return d
+
+
 def _resolver_role_status(resolver, idx: int | None = None) -> dict[str, Any]:
     """One resolver's status block, shared by both tiers: counters plus
     the per-stage pipeline timing breakdown (ResolverRole.pipeline_status)."""
@@ -80,12 +97,7 @@ def _sharded_status(cluster) -> dict[str, Any]:
             "latest_version": master.version,
             "committed_version": master.committed.get(),
         },
-        {
-            "role": "proxy",
-            "txns_committed": proxy.txns_committed,
-            "txns_conflicted": proxy.txns_conflicted,
-            "txns_too_old": proxy.txns_too_old,
-        },
+        _proxy_role_status(proxy),
     ]
     # Resolver fleet with the pipeline observability block: per-stage
     # pack/h2d/device/d2h p50+p99 and the live/measured in-flight depth —
@@ -201,13 +213,8 @@ def _local_status(cluster) -> dict[str, Any]:
             "latest_version": master.version,
             "committed_version": master.committed.get(),
         },
-        {
-            "role": "proxy",
-            "txns_committed": proxy.txns_committed,
-            "txns_conflicted": proxy.txns_conflicted,
-            "txns_too_old": proxy.txns_too_old,
-            "commit_batches_in_flight": len(proxy.commit_stream),
-        },
+        dict(_proxy_role_status(proxy),
+             commit_batches_in_flight=len(proxy.commit_stream)),
         _resolver_role_status(resolver),
         {
             "role": "log",

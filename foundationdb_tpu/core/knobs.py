@@ -61,6 +61,31 @@ class ServerKnobs(Knobs):
         # Commit batching (ref: fdbserver/Knobs.cpp:221-223)
         init("COMMIT_TRANSACTION_BATCH_INTERVAL_MIN", 0.0005, sim_random_range=(0.0005, 0.005))
         init("COMMIT_TRANSACTION_BATCH_COUNT_MAX", 32768, sim_random_range=(16, 32768))
+        # Adaptive commit coalescing (proxy.py _AdaptiveBatchInterval, ref:
+        # the reference's dynamic commitBatchInterval feedback,
+        # MasterProxyServer.actor.cpp:244-262): the batcher's deadline
+        # floats between MIN and MAX driven by recent batch fill against
+        # the byte target — underfull deadline-closed batches stretch the
+        # wait (coalesce more per batch, amortize the per-batch pipeline
+        # cost), full batches shave it (load forms full batches without
+        # coalescing delay).
+        init("COMMIT_TRANSACTION_BATCH_INTERVAL_MAX", 0.005, sim_random_range=(0.001, 0.02))
+        init("COMMIT_BATCH_BYTES_TARGET", 1 << 20, sim_random_range=(1 << 12, 1 << 20))
+        # Commit-plane pipelining (proxy.py _commit_batch): how many commit
+        # versions may be in flight across the proxy->resolver->tlog
+        # stages before the next batch must wait for the oldest window's
+        # replies. Replies always release in commit-version order (the
+        # _replied chain); depth 1 degenerates to the strictly serial
+        # one-window-at-a-time path.
+        init("PROXY_PIPELINE_DEPTH", 4, sim_random_range=(1, 4))
+        # GRV fast path (proxy.py _answer_grv_batch): serve read versions
+        # from the proxy's live committed-version cache when the last
+        # successful confirm-epoch-live is at most this many milliseconds
+        # old, amortizing the quorum-liveness round trip across batches.
+        # 0 disables the cache (every batch confirms — the strict path);
+        # nonzero bounds the stale-read window a partitioned deposed
+        # proxy could serve to this many ms, far below any recovery time.
+        init("GRV_CACHE_STALENESS_MS", 0.0, sim_random_range=(0.0, 20.0))
         # Conflict-set backend recruited by deployed tiers (resolver/
         # factory.py): oracle | native | tpu. Deployed clusters default to
         # the native C++ detector; the TPU kernel is opt-in per deployment
@@ -111,6 +136,12 @@ class ServerKnobs(Knobs):
         # (resolver/wire.py) alongside/instead of txn object lists, so the
         # resolver-side pack is the vectorized np.frombuffer path.
         init("RESOLVER_WIRE_BATCH", True)
+        # Cross-process tlog pushes ship ONE packed buffer per log
+        # (commit_wire.pack_tagged_mutations) instead of per-mutation
+        # TaggedMutation objects through the recursive wire encoder —
+        # the txn->log twin of RESOLVER_WIRE_BATCH (multiprocess tier
+        # only; the in-process log systems never serialize).
+        init("TLOG_WIRE_BATCH", True)
         # Storage (ref: fdbserver/Knobs.cpp storage section)
         init("STORAGE_DURABILITY_LAG_VERSIONS", 5 * 1_000_000)
         init("STORAGE_COMMIT_INTERVAL", 0.5)
@@ -166,6 +197,19 @@ class ClientKnobs(Knobs):
         init("VALUE_SIZE_LIMIT", 100_000)
         init("MAX_BATCH_SIZE", 1000)
         init("GRV_BATCH_INTERVAL", 0.001)
+        # Client-side GRV coalescing (connection.get_read_version):
+        # concurrent same-priority GRVs share one in-flight request while
+        # it is unanswered (ref: NativeAPI's readVersionBatcher) — N
+        # closed-loop clients cost ~one GRV RPC per round trip, not N.
+        init("GRV_COALESCE", True)
+        # Client-side commit wire batching (connection.py): concurrent
+        # commits from one client process coalesce into ONE columnar
+        # CommitWireBatch buffer per flush window instead of N pickled
+        # request objects (multiprocess tier only — the batch endpoint is
+        # published by the txn host; in-process tiers keep direct sends).
+        init("COMMIT_WIRE_BATCH", True)
+        init("COMMIT_WIRE_BATCH_INTERVAL", 0.0005)
+        init("COMMIT_WIRE_BATCH_COUNT_MAX", 512)
         init("DEFAULT_BACKOFF", 0.01)
         # Client-side RPC deadlines (reads/GRVs re-send after these; a lost
         # commit reply becomes commit_unknown_result).

@@ -85,6 +85,21 @@ class ContinuousSample:
         self._sorted = False
 
 
+def stage_percentiles(samples: dict) -> dict:
+    """{stage: {"p50", "p99", "samples"}} from a dict of ContinuousSample
+    reservoirs — the shared shape of the resolver's and the commit
+    proxy's `status json` pipeline-stage blocks."""
+    def pct(s: ContinuousSample, q: float):
+        v = s.percentile(q)
+        return round(v, 3) if v is not None else None
+
+    return {
+        k: {"p50": pct(s, 0.5), "p99": pct(s, 0.99),
+            "samples": s.population}
+        for k, s in samples.items()
+    }
+
+
 class Smoother:
     """Exponential smoother over continuous (wall/sim) time (ref:
     fdbrpc/Smoother.h). `smooth_total()` converges toward the last set

@@ -1,0 +1,27 @@
+"""Optional native helpers surfaced to core/ (built from native/ — see
+the Makefile). Today: `crc32c`, the slice-by-8 C implementation of the
+Castagnoli CRC the wire framing checksums every packet with (same value
+as the pure-Python table walk in core/serialize.py, ~100x faster — the
+Python loop was a top-5 cost on the 1-core commit plane).
+
+Importing this module raises ImportError when the library is not
+loadable or predates the export, so core/serialize.py keeps its
+pure-Python fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+from .storage_engine import _native
+
+_lib = _native.load()
+if _lib is None or not hasattr(_lib, "fdbtpu_crc32c"):
+    raise ImportError("libfdbtpu_native.so missing fdbtpu_crc32c")
+_lib.fdbtpu_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
+                               ctypes.c_uint32]
+_lib.fdbtpu_crc32c.restype = ctypes.c_uint32
+
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    return _lib.fdbtpu_crc32c(data, len(data), crc)

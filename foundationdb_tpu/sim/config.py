@@ -64,6 +64,21 @@ _KNOB_RANGES = [
     # depth >1 runs the submit/verdicts overlap with its dual version
     # chains (dispatch vs consumption) under the seed's chaos mix.
     ("TPU_PIPELINE_DEPTH", "server", (1, 4)),
+    # r9: the commit-plane pipeline (proxy.py dual chains) — depth 1 pins
+    # the strictly serial plane (bit-identical to the pre-pipeline path),
+    # depth >1 keeps several commit versions in flight across
+    # proxy->resolver->tlog under chaos, with replies still released in
+    # commit-version order.
+    ("PROXY_PIPELINE_DEPTH", "server", (1, 4)),
+    # r9: GRV fast path — 0 pins the strict per-batch confirm; positive
+    # draws serve read versions from the committed cache between epoch
+    # confirms, so chaos seeds exercise the amortized-liveness window
+    # against recoveries (the bound is ms-scale vs second-scale leases).
+    ("GRV_CACHE_STALENESS_MS", "server", (0.0, 20.0)),
+    # r9: adaptive commit coalescing — byte target + deadline ceiling of
+    # the floating batch-close controller (proxy._AdaptiveBatchInterval).
+    ("COMMIT_BATCH_BYTES_TARGET", "server", (1 << 12, 1 << 20)),
+    ("COMMIT_TRANSACTION_BATCH_INTERVAL_MAX", "server", (0.001, 0.02)),
 ]
 
 # Categorical knob draws (same subset-randomization policy as the ranges).

@@ -1,0 +1,51 @@
+// Exported CRC32C (Castagnoli) for the Python wire framing — the same
+// reflected-0x82F63B78 table CRC the in-tree C client and the durable
+// page formats compute, at C speed (the pure-Python fallback in
+// core/serialize.py walks the table per byte and shows up as a top-5
+// cost on the 1-core commit plane).  Slice-by-8 keeps it portable.
+
+#include <cstddef>
+#include <cstdint>
+
+namespace {
+
+struct Tables {
+  uint32_t t[8][256];
+  Tables() {
+    for (uint32_t n = 0; n < 256; n++) {
+      uint32_t c = n;
+      for (int k = 0; k < 8; k++) c = (c & 1) ? (c >> 1) ^ 0x82F63B78u : c >> 1;
+      t[0][n] = c;
+    }
+    for (uint32_t n = 0; n < 256; n++) {
+      uint32_t c = t[0][n];
+      for (int k = 1; k < 8; k++) {
+        c = t[0][c & 0xFF] ^ (c >> 8);
+        t[k][n] = c;
+      }
+    }
+  }
+};
+
+const Tables kT;
+
+}  // namespace
+
+extern "C" uint32_t fdbtpu_crc32c(const uint8_t* p, size_t n, uint32_t crc) {
+  uint32_t c = crc ^ 0xFFFFFFFFu;
+  while (n >= 8) {
+    uint64_t w = (uint64_t)p[0] | ((uint64_t)p[1] << 8) | ((uint64_t)p[2] << 16) |
+                 ((uint64_t)p[3] << 24) | ((uint64_t)p[4] << 32) |
+                 ((uint64_t)p[5] << 40) | ((uint64_t)p[6] << 48) |
+                 ((uint64_t)p[7] << 56);
+    w ^= c;
+    c = kT.t[7][w & 0xFF] ^ kT.t[6][(w >> 8) & 0xFF] ^ kT.t[5][(w >> 16) & 0xFF] ^
+        kT.t[4][(w >> 24) & 0xFF] ^ kT.t[3][(w >> 32) & 0xFF] ^
+        kT.t[2][(w >> 40) & 0xFF] ^ kT.t[1][(w >> 48) & 0xFF] ^
+        kT.t[0][(w >> 56) & 0xFF];
+    p += 8;
+    n -= 8;
+  }
+  while (n--) c = kT.t[0][(c ^ *p++) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}

@@ -1,0 +1,508 @@
+"""Heavy-traffic commit plane (ISSUE 8): the pipelined proxy's dual
+version chains, the GRV fast path's staleness bound, adaptive commit
+coalescing, the columnar client-commit codec, and the commit_pipeline
+status block — plus the GRV throttle requeue FIFO fix.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from foundationdb_tpu.cluster.cluster import LocalCluster
+from foundationdb_tpu.cluster.interfaces import (
+    CommitTransactionRequest,
+    GetReadVersionRequest,
+    Mutation,
+)
+from foundationdb_tpu.core.knobs import SERVER_KNOBS
+from foundationdb_tpu.core.runtime import TaskPriority, current_loop, spawn
+from foundationdb_tpu.kv.atomic import MutationType
+from foundationdb_tpu.kv.keys import KeyRange
+
+
+@pytest.fixture
+def knob(monkeypatch):
+    def set_knob(name, value, registry=SERVER_KNOBS):
+        monkeypatch.setattr(registry, name, value)
+
+    return set_knob
+
+
+def _commit_req(i: int) -> CommitTransactionRequest:
+    key = b"k%04d" % i
+    return CommitTransactionRequest(
+        read_snapshot=0,
+        read_conflict_ranges=(),
+        write_conflict_ranges=(),
+        mutations=(Mutation(MutationType.SET_VALUE, key, b"v%d" % i),),
+    )
+
+
+# ---------------------------------------------------------------------------
+# pipelined proxy: dual chains
+# ---------------------------------------------------------------------------
+
+def test_proxy_pipeline_depth_measured_and_replies_in_order(sim, knob):
+    """With depth 4 and many concurrent commits, the proxy must actually
+    keep multiple commit versions in flight (measured, not configured)
+    while replies release in commit-version order."""
+    knob("PROXY_PIPELINE_DEPTH", 4)
+    knob("COMMIT_TRANSACTION_BATCH_COUNT_MAX", 4)
+    cluster = LocalCluster().start()
+    # In-process the tlog never suspends, so stages cannot overlap; model
+    # the deployed fsync/RPC hop with a delay — the stage the pipeline
+    # exists to overlap.
+    orig_tlog = cluster.proxy._tlog_commit
+
+    async def slow_tlog(prev_version, version, mutations):
+        await current_loop().delay(0.005)
+        return await orig_tlog(prev_version, version, mutations)
+
+    cluster.proxy._tlog_commit = slow_tlog
+    reply_versions = []
+
+    async def one(i):
+        req = _commit_req(i)
+        cluster.proxy.commit_stream.send(req)
+        cid = await req.reply.future
+        reply_versions.append(cid.version)
+        return cid.version
+
+    async def main():
+        tasks = [spawn(one(i), TaskPriority.DEFAULT, name=f"c{i}")
+                 for i in range(64)]
+        from foundationdb_tpu.core.actors import all_of
+
+        out = await all_of([t.done for t in tasks])
+        cluster.stop()
+        return out
+
+    sim.run(main(), timeout_sim_seconds=60)
+    # Observed reply release order == commit-version order.
+    assert reply_versions == sorted(reply_versions)
+    assert cluster.proxy.max_commit_inflight >= 2, (
+        cluster.proxy.max_commit_inflight
+    )
+    ps = cluster.proxy.commit_pipeline_status()
+    assert ps["depth_configured"] == 4
+    assert ps["max_in_flight_measured"] >= 2
+    assert ps["stages"]["resolve_ms"]["samples"] >= 2
+    assert ps["stages"]["tlog_ms"]["samples"] >= 2
+    assert ps["stages"]["form_ms"]["samples"] >= 2
+
+
+def test_proxy_depth1_is_serial(sim, knob):
+    """Depth 1 pins the strictly serial plane: never more than one commit
+    version in flight, replies still correct."""
+    knob("PROXY_PIPELINE_DEPTH", 1)
+    knob("COMMIT_TRANSACTION_BATCH_COUNT_MAX", 4)
+    cluster = LocalCluster().start()
+
+    async def one(i):
+        req = _commit_req(i)
+        cluster.proxy.commit_stream.send(req)
+        return (await req.reply.future).version
+
+    async def main():
+        from foundationdb_tpu.core.actors import all_of
+
+        tasks = [spawn(one(i), TaskPriority.DEFAULT, name=f"c{i}")
+                 for i in range(24)]
+        out = await all_of([t.done for t in tasks])
+        cluster.stop()
+        return out
+
+    versions = sim.run(main(), timeout_sim_seconds=60)
+    assert cluster.proxy.max_commit_inflight == 1
+    assert cluster.proxy.txns_committed == 24
+    assert len(versions) == 24
+
+
+def test_depth4_fingerprint_identical_to_depth1():
+    """The acceptance differential: a Cycle workload's final keyspace is
+    bit-identical between the serial plane (depth 1) and the pipelined
+    plane (depth 4) on the same seed — the pipeline changes WHEN the host
+    overlaps stages, never what commits."""
+    from foundationdb_tpu.workloads.tester import run_spec
+
+    def run(depth: int):
+        spec = {
+            "seed": 777,
+            "buggify": False,
+            "knobs": {"server:PROXY_PIPELINE_DEPTH": depth},
+            "cluster": {"kind": "recoverable_sharded", "n_storage": 3,
+                        "n_logs": 2, "replication": "double",
+                        "topology": {"n_dcs": 1, "machines_per_dc": 3}},
+            "workloads": [
+                {"name": "Cycle", "nodes": 12, "clients": 3, "txns": 15},
+            ],
+        }
+        res = run_spec(spec)
+        assert res.get("ok"), res
+        assert not res.get("sev_errors"), res
+        return res
+
+    r1, r4 = run(1), run(4)
+    assert "fingerprint" in r1 and r1["fingerprint"], r1
+    assert r1["fingerprint"] == r4["fingerprint"]
+
+
+def test_commit_plane_pipelined_under_attrition():
+    """Chaos smoke at the ISSUE's knobs: depth 4, GRV cache on, adaptive
+    coalescing targets randomized-low — the dual chains and the amortized
+    liveness check must hold across recoveries."""
+    from foundationdb_tpu.workloads.tester import run_spec
+
+    spec = {
+        "seed": 909,
+        "buggify": True,
+        "knobs": {"server:PROXY_PIPELINE_DEPTH": 4,
+                  "server:GRV_CACHE_STALENESS_MS": 5.0,
+                  "server:COMMIT_BATCH_BYTES_TARGET": 4096},
+        "cluster": {"kind": "recoverable_sharded", "n_storage": 4,
+                    "n_logs": 2, "replication": "double",
+                    "topology": {"n_dcs": 1, "machines_per_dc": 3}},
+        "workloads": [
+            {"name": "Cycle", "nodes": 12, "clients": 3, "txns": 15},
+            {"name": "MachineAttrition", "interval": 0.8, "kills": 1,
+             "reboots": 1, "outage": 0.3},
+        ],
+    }
+    res = run_spec(spec)
+    assert res.get("ok"), res
+    assert not res.get("sev_errors"), res
+
+
+# ---------------------------------------------------------------------------
+# GRV fast path
+# ---------------------------------------------------------------------------
+
+def test_grv_cache_amortizes_confirms_and_respects_bounds(sim, knob):
+    """Within the staleness window GRVs serve from the committed cache
+    (one confirm per window, not per batch); every served version is
+    <= committed-now and >= committed as of (now - staleness - batch
+    interval) — the two bounds the satellite names."""
+    knob("GRV_CACHE_STALENESS_MS", 50.0)
+    cluster = LocalCluster().start()
+    proxy = cluster.proxy
+    committed_history = []  # (time, committed) samples
+    served = []             # (time, version)
+
+    async def sampler():
+        loop = current_loop()
+        while True:
+            committed_history.append(
+                (loop.now(), cluster.master.get_live_committed_version())
+            )
+            await loop.delay(0.001)
+
+    async def main():
+        loop = current_loop()
+        st = spawn(sampler(), TaskPriority.DEFAULT, name="sampler")
+        db = cluster.database()
+        for i in range(30):
+            await db.set(b"k%d" % (i % 8), b"v%d" % i)
+            req = GetReadVersionRequest()
+            proxy.grv_stream.send(req)
+            v = await req.reply.future
+            served.append((loop.now(), v))
+        st.cancel()
+        cluster.stop()
+
+    sim.run(main(), timeout_sim_seconds=120)
+    assert proxy._c_grv_cached.total > 0, "fast path never taken"
+    staleness = 0.050
+    slack = 0.01  # batch interval + sampler granularity
+    for t, v in served:
+        committed_now = max(
+            (c for ts, c in committed_history if ts <= t), default=0
+        )
+        committed_floor = max(
+            (c for ts, c in committed_history
+             if ts <= t - staleness - slack), default=0
+        )
+        assert v <= committed_now
+        assert v >= committed_floor, (t, v, committed_floor)
+
+
+def test_grv_cache_off_confirms_every_batch(sim, knob):
+    """Staleness 0 (the default) pins today's strict path: zero cached
+    serves, a confirm per answered batch."""
+    knob("GRV_CACHE_STALENESS_MS", 0.0)
+    cluster = LocalCluster().start()
+
+    async def main():
+        for _ in range(5):
+            req = GetReadVersionRequest()
+            cluster.proxy.grv_stream.send(req)
+            await req.reply.future
+        cluster.stop()
+
+    sim.run(main(), timeout_sim_seconds=30)
+    assert cluster.proxy._c_grv_cached.total == 0
+    assert cluster.proxy._c_grv.total == 5
+
+
+def test_grv_throttle_requeue_fifo_counts_once(sim, knob):
+    """The small fix, pinned at the mechanism: deferred GRVs rejoin the
+    stream FRONT via unpop in arrival order (a queued younger arrival can
+    no longer be batched ahead of them), and GRVsThrottled counts each
+    throttled request exactly once across repeated deferrals."""
+
+    class StingyRatekeeper:
+        def __init__(self, admits):
+            self.admits = list(admits)
+
+        def admit_transactions(self, n: int) -> int:
+            return self.admits.pop(0) if self.admits else n
+
+    class RecorderStream:
+        """grv_stream stand-in: records how the requeue path returns
+        deferred requests (front-unpop vs back-send)."""
+
+        def __init__(self):
+            self.unpopped = []
+            self.sent = []
+
+        def unpop(self, r):
+            self.unpopped.append(r)
+
+        def send(self, r):
+            self.sent.append(r)
+
+    cluster = LocalCluster()  # not started: drive _answer_grv_batch directly
+    proxy = cluster.proxy
+    rec = RecorderStream()
+    proxy.grv_stream = rec
+    proxy.ratekeeper = StingyRatekeeper([1, 0])
+    reqs = [GetReadVersionRequest() for _ in range(3)]
+
+    async def main():
+        loop = current_loop()
+        # Batch 1: one admitted (answered), two deferred.
+        await proxy._answer_grv_batch(list(reqs))
+        await loop.delay(0.06)  # let the requeue fire
+        first_unpops = list(rec.unpopped)
+        count_after_first = proxy._c_grv_throttled.total
+        # The same two requests throttled AGAIN: no double count.
+        await proxy._answer_grv_batch([reqs[1], reqs[2]])
+        await loop.delay(0.06)
+        proxy._tasks.cancel_all()
+        return first_unpops, count_after_first
+
+    first_unpops, count_after_first = sim.run(main(),
+                                              timeout_sim_seconds=30)
+    assert reqs[0].reply.is_set()
+    # unpop pushes to the FRONT, so arrival order needs reversed handoff:
+    # net effect, the stream pops r1 then r2 — their arrival order.
+    assert first_unpops == [reqs[2], reqs[1]]
+    assert count_after_first == 2
+    # Second deferral of the SAME requests added nothing.
+    assert proxy._c_grv_throttled.total == 2
+    assert rec.unpopped[2:] == [reqs[2], reqs[1]]
+    assert rec.sent == []  # the requeue path never appends to the back
+
+
+# ---------------------------------------------------------------------------
+# adaptive coalescing
+# ---------------------------------------------------------------------------
+
+def test_adaptive_interval_tracks_latency_fraction(knob):
+    """The deadline follows ~LATENCY_FRACTION of the smoothed pipeline
+    latency (formation never costs more than ~10% of the pipeline),
+    clamps to [MIN, MAX], and pins at MIN once batches fill before the
+    deadline (the count/byte triggers close them instead)."""
+    from foundationdb_tpu.cluster.proxy import _AdaptiveBatchInterval
+
+    knob("COMMIT_TRANSACTION_BATCH_INTERVAL_MIN", 0.0005)
+    knob("COMMIT_TRANSACTION_BATCH_INTERVAL_MAX", 0.005)
+    knob("COMMIT_TRANSACTION_BATCH_COUNT_MAX", 100)
+    knob("COMMIT_BATCH_BYTES_TARGET", 1 << 20)
+    ai = _AdaptiveBatchInterval()
+    assert ai.value == SERVER_KNOBS.COMMIT_TRANSACTION_BATCH_INTERVAL_MIN
+    for _ in range(50):  # underfull trickle, 20 ms pipeline
+        ai.record_close("deadline", 1, 100)
+        ai.record_latency(0.020)
+    assert 0.0015 <= ai.value <= 0.0025, ai.value  # ~10% of 20 ms
+    for _ in range(50):  # 100 ms pipeline: clamped at MAX
+        ai.record_latency(0.100)
+    assert ai.value == SERVER_KNOBS.COMMIT_TRANSACTION_BATCH_INTERVAL_MAX
+    for _ in range(50):  # slam: every batch hits the count cap
+        ai.record_close("count", 100, 1 << 20)
+        ai.record_latency(0.020)
+    assert ai.value == SERVER_KNOBS.COMMIT_TRANSACTION_BATCH_INTERVAL_MIN
+
+
+def test_batcher_closes_on_bytes_target(sim, knob):
+    """The byte trigger: requests with big mutations close the batch at
+    COMMIT_BATCH_BYTES_TARGET, not the count cap."""
+    knob("COMMIT_BATCH_BYTES_TARGET", 2048)
+    knob("COMMIT_TRANSACTION_BATCH_COUNT_MAX", 1000)
+    knob("COMMIT_TRANSACTION_BATCH_INTERVAL_MIN", 0.05)
+    knob("COMMIT_TRANSACTION_BATCH_INTERVAL_MAX", 0.05)
+    cluster = LocalCluster().start()
+    batch_sizes = []
+    orig = cluster.proxy._commit_batch_impl
+
+    async def spy(reqs, prev_version, version):
+        batch_sizes.append(len(reqs))
+        return await orig(reqs, prev_version, version)
+
+    cluster.proxy._commit_batch_impl = spy
+
+    async def main():
+        from foundationdb_tpu.core.actors import all_of
+
+        reqs = []
+        for i in range(8):
+            r = CommitTransactionRequest(
+                read_snapshot=0, read_conflict_ranges=(),
+                write_conflict_ranges=(),
+                mutations=(Mutation(MutationType.SET_VALUE,
+                                    b"k%d" % i, b"x" * 700),),
+            )
+            reqs.append(r)
+            cluster.proxy.commit_stream.send(r)
+        await all_of([r.reply.future for r in reqs])
+        cluster.stop()
+
+    sim.run(main(), timeout_sim_seconds=30)
+    # ~700B per request against a 2KB target: batches close every ~3
+    # requests instead of all 8 in the 50 ms window.
+    assert max(batch_sizes) <= 4, batch_sizes
+    assert len(batch_sizes) >= 2
+
+
+# ---------------------------------------------------------------------------
+# columnar client-commit codec
+# ---------------------------------------------------------------------------
+
+def test_commit_wire_roundtrip_exact():
+    from foundationdb_tpu.cluster.commit_wire import CommitWireBatch
+
+    reqs = [
+        CommitTransactionRequest(
+            read_snapshot=5,
+            read_conflict_ranges=(KeyRange(b"a", b"b"),
+                                  KeyRange(b"", b"\xff")),
+            write_conflict_ranges=(KeyRange(b"c", b"d"),),
+            mutations=(Mutation(MutationType.SET_VALUE, b"k", b"v" * 300),),
+        ),
+        CommitTransactionRequest(
+            read_snapshot=-1,
+            read_conflict_ranges=(),
+            write_conflict_ranges=(),
+            mutations=(
+                Mutation(MutationType.CLEAR_RANGE, b"a", b"z"),
+                Mutation(MutationType.ADD_VALUE, b"ctr", b"\x01"),
+                Mutation(MutationType.SET_VERSIONSTAMPED_KEY,
+                         b"p\x00\x00\x00\x00\x00\x00\x00\x00\x00\x00\x01\x00\x00\x00",
+                         b""),
+            ),
+        ),
+        CommitTransactionRequest(
+            read_snapshot=1 << 40, read_conflict_ranges=(),
+            write_conflict_ranges=(), mutations=(),
+        ),
+    ]
+    back = CommitWireBatch.from_bytes(
+        CommitWireBatch.from_reqs(reqs).to_bytes()
+    ).to_reqs()
+    assert len(back) == len(reqs)
+    for o, b in zip(reqs, back):
+        assert o.read_snapshot == b.read_snapshot
+        assert tuple(o.read_conflict_ranges) == tuple(b.read_conflict_ranges)
+        assert tuple(o.write_conflict_ranges) == tuple(b.write_conflict_ranges)
+        assert tuple(o.mutations) == tuple(b.mutations)
+        assert not b.reply.is_set()
+
+
+def test_tagged_mutation_wire_roundtrip():
+    """The tlog-push twin (TLOG_WIRE_BATCH): tag vectors + mutations
+    survive the packed buffer exactly."""
+    from foundationdb_tpu.cluster.commit_wire import (
+        pack_tagged_mutations,
+        unpack_tagged_mutations,
+    )
+    from foundationdb_tpu.cluster.log_system import TaggedMutation
+
+    tms = [
+        TaggedMutation((0, 2), Mutation(MutationType.SET_VALUE,
+                                        b"k1", b"v" * 100)),
+        TaggedMutation((), Mutation(MutationType.CLEAR_RANGE, b"a", b"z")),
+        TaggedMutation((1,), Mutation(MutationType.ADD_VALUE,
+                                      b"", b"\x00\x01")),
+    ]
+    back = unpack_tagged_mutations(pack_tagged_mutations(tms))
+    assert back == tms
+    assert unpack_tagged_mutations(pack_tagged_mutations([])) == []
+
+
+def test_commit_outcomes_pack_roundtrip():
+    from foundationdb_tpu.cluster.commit_wire import (
+        pack_outcomes,
+        unpack_outcomes,
+    )
+
+    outs = [(0, 12345, b"\x01" * 10, ""), (1, 0, b"", "conflict!"),
+            (3, 0, b"", "reply not received"), (4, -1, b"x", "boom")]
+    assert unpack_outcomes(pack_outcomes(outs)) == outs
+    assert unpack_outcomes(pack_outcomes([])) == []
+
+
+def test_commit_wire_empty_batch():
+    from foundationdb_tpu.cluster.commit_wire import CommitWireBatch
+
+    back = CommitWireBatch.from_bytes(
+        CommitWireBatch.from_reqs([]).to_bytes()
+    ).to_reqs()
+    assert back == []
+
+
+# ---------------------------------------------------------------------------
+# status blocks
+# ---------------------------------------------------------------------------
+
+def test_status_json_commit_pipeline_block_local(sim):
+    from foundationdb_tpu.cluster.status import cluster_status
+
+    cluster = LocalCluster().start()
+
+    async def main():
+        db = cluster.database()
+        for i in range(4):
+            await db.set(b"s%d" % i, b"v")
+        st = cluster_status(cluster)
+        cluster.stop()
+        return st
+
+    st = sim.run(main(), timeout_sim_seconds=30)
+    proxy_role = next(r for r in st["cluster"]["roles"]
+                      if r["role"] == "proxy")
+    cp = proxy_role["commit_pipeline"]
+    assert set(cp["stages"]) == {"grv_ms", "form_ms", "resolve_ms",
+                                 "tlog_ms"}
+    assert cp["depth_configured"] >= 1
+    assert cp["stages"]["resolve_ms"]["samples"] >= 1
+    assert "grv_cache" in cp and "batch_interval_ms" in cp
+
+
+def test_status_json_commit_pipeline_block_sharded(sim):
+    from foundationdb_tpu.cluster.recovery import RecoverableShardedCluster
+    from foundationdb_tpu.cluster.status import cluster_status
+
+    rc = RecoverableShardedCluster(n_storage=3, n_logs=1,
+                                   replication="single").start()
+
+    async def main():
+        db = rc.database()
+        await db.set(b"a", b"1")
+        st = cluster_status(rc)
+        rc.stop()
+        return st
+
+    st = sim.run(main(), timeout_sim_seconds=60)
+    proxy_role = next(r for r in st["cluster"]["roles"]
+                      if r["role"] == "proxy")
+    assert "commit_pipeline" in proxy_role
+    assert proxy_role["commit_pipeline"]["stages"]["tlog_ms"]["samples"] >= 1

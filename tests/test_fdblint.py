@@ -224,6 +224,63 @@ def test_async_await_in_finally_bad_good(tmp_path):
     assert [f.line for f in fs if not f.suppressed] == [6]
 
 
+def test_grv_cache_liveness_bad_no_confirm(tmp_path):
+    fs = run_lint(tmp_path, {SIM: """
+        async def _answer_grv_batch(self, reqs):
+            v = self.master.get_live_committed_version()
+            for r in reqs:
+                r.reply.send(v)
+    """})
+    assert rules_of(fs) == ["grv-cache-liveness"]
+
+
+def test_grv_cache_liveness_bad_unbounded_elision(tmp_path):
+    # The confirm is skippable but the guard has nothing to do with the
+    # staleness knob: a cached GRV could be served forever.
+    fs = run_lint(tmp_path, {SIM: """
+        async def _answer_grv_batch(self, reqs):
+            v = self.master.get_live_committed_version()
+            if self.lucky:
+                await self._confirm_epoch_live()
+            for r in reqs:
+                r.reply.send(v)
+    """})
+    assert rules_of(fs) == ["grv-cache-liveness"]
+
+
+def test_grv_cache_liveness_good_staleness_guard_and_strict(tmp_path):
+    # Good twins: the elision derived (transitively) from the staleness
+    # knob, and the strict unconditional confirm; tests/ scope exempt.
+    fs = run_lint(tmp_path, {
+        SIM: """
+            from ..core.knobs import SERVER_KNOBS
+
+            async def _answer_grv_batch(self, reqs):
+                v = self.master.get_live_committed_version()
+                staleness = SERVER_KNOBS.GRV_CACHE_STALENESS_MS / 1e3
+                cached = staleness > 0 and self.fresh_within(staleness)
+                if cached:
+                    self.count_cached(len(reqs))
+                else:
+                    await self._confirm_epoch_live()
+                for r in reqs:
+                    r.reply.send(v)
+
+            async def _answer_grv_strict(self, reqs):
+                v = self.master.get_live_committed_version()
+                await self._confirm_epoch_live()
+                for r in reqs:
+                    r.reply.send(v)
+        """,
+        "tests/helper.py": """
+            async def fake_grv_server(reqs):
+                for r in reqs:
+                    r.reply.send(1)
+        """,
+    })
+    assert rules_of(fs) == []
+
+
 # ---------------------------------------------------------------------------
 # pack 3: JAX kernel hazards
 # ---------------------------------------------------------------------------

@@ -43,6 +43,7 @@ RULES: dict[str, str] = {
     "async-blocking": "blocking primitive (time.sleep, sync open(), subprocess) inside async def",
     "async-unawaited": "coroutine created but neither awaited nor handed to spawn/Task",
     "async-await-in-finally": "await inside finally without cancellation shielding",
+    "grv-cache-liveness": "GRV served without a quorum-liveness confirm, or with a confirm elision not bounded by GRV_CACHE_STALENESS_MS",
     "jax-donated-reuse": "buffer read after being donated to a jit(donate_argnums=...) call",
     "jax-tracer-concrete": "Python bool()/int()/if/while/.item() on a tracer inside a jitted function",
     "jax-host-sync": "host sync (np.asarray, .block_until_ready) inside a jitted function",

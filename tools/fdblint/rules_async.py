@@ -142,9 +142,124 @@ class _Scan(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+# -- grv-cache-liveness ------------------------------------------------------
+# A GRV answered without a quorum-liveness confirm is a stale-read hazard
+# (a partitioned deposed proxy keeps serving versions that predate the
+# successor's commits — proxy.py _confirm_epoch_live's docstring).  The
+# GRV fast path may AMORTIZE the confirm across batches, but only inside
+# the GRV_CACHE_STALENESS_MS window: any branch that skips the confirm
+# must be guarded by a condition derived from that knob.  The rule flags
+# GRV-serving async functions (name contains "grv", foundationdb_tpu/
+# scope) that either never confirm at all, or make the confirm
+# conditional on something other than the staleness knob.
+
+_STALENESS_KNOB = "GRV_CACHE_STALENESS"
+
+
+def _mentions(node: ast.AST, needle: str, tainted: set[str]) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and needle in sub.attr:
+            return True
+        if isinstance(sub, ast.Name) and (needle in sub.id
+                                          or sub.id in tainted):
+            return True
+    return False
+
+
+def _staleness_tainted_names(fn: ast.AST) -> set[str]:
+    """Names assigned (transitively) from expressions mentioning the
+    staleness knob — `staleness = KNOBS.GRV_CACHE_STALENESS_MS / 1e3;
+    fresh = staleness > 0 and ...` taints both."""
+    tainted: set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for sub in ast.walk(fn):
+            if not isinstance(sub, ast.Assign) or sub.value is None:
+                continue
+            if not _mentions(sub.value, _STALENESS_KNOB, tainted):
+                continue
+            for tgt in sub.targets:
+                if isinstance(tgt, ast.Name) and tgt.id not in tainted:
+                    tainted.add(tgt.id)
+                    changed = True
+    return tainted
+
+
+class _GrvScan(ast.NodeVisitor):
+    """Within one GRV-serving function: confirm-call sites with their
+    enclosing If-test stack, plus reply sends."""
+
+    def __init__(self):
+        self.confirms: list[tuple[ast.Call, list[ast.AST]]] = []
+        self.reply_sends: list[ast.Call] = []
+        self._if_tests: list[ast.AST] = []
+
+    def visit_If(self, node):  # noqa: N802
+        self._if_tests.append(node.test)
+        for child in node.body:
+            self.visit(child)
+        for child in node.orelse:
+            self.visit(child)
+        self._if_tests.pop()
+
+    def visit_Call(self, node):  # noqa: N802
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            if "confirm_epoch" in fn.attr:
+                self.confirms.append((node, list(self._if_tests)))
+            elif (fn.attr == "send" and isinstance(fn.value, ast.Attribute)
+                    and fn.value.attr == "reply"):
+                self.reply_sends.append(node)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):  # noqa: N802 — nested defs are
+        pass  # their own serving scope, not this one's
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _check_grv_cache(ctx: FileCtx) -> list[Finding]:
+    if not ctx.path.startswith("foundationdb_tpu/"):
+        return []
+    findings: list[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.AsyncFunctionDef):
+            continue
+        if "grv" not in node.name.lower():
+            continue
+        scan = _GrvScan()
+        for child in node.body:
+            scan.visit(child)
+        if not scan.reply_sends:
+            continue
+        if not scan.confirms:
+            findings.append(Finding(
+                ctx.path, node.lineno, "grv-cache-liveness",
+                f"{node.name}() serves GRV replies without any "
+                "confirm-epoch-live call: a partitioned deposed proxy "
+                "would keep handing out read versions that predate the "
+                "successor's commits (stale reads)"))
+            continue
+        tainted = _staleness_tainted_names(node)
+        for call, tests in scan.confirms:
+            if not tests:
+                continue  # unconditional confirm: the strict path
+            if any(_mentions(t, _STALENESS_KNOB, tainted) for t in tests):
+                continue  # elision bounded by the staleness knob
+            findings.append(Finding(
+                ctx.path, call.lineno, "grv-cache-liveness",
+                "confirm-epoch-live is skippable here but the guard does "
+                f"not derive from {_STALENESS_KNOB}_MS: a cached GRV "
+                "served outside the staleness window is an unbounded "
+                "stale-read hazard",
+                end_line=call.end_lineno or call.lineno))
+    return findings
+
+
 def check(ctx: FileCtx) -> list[Finding]:
     defs = _AsyncDefs()
     defs.visit(ctx.tree)
     scan = _Scan(ctx, defs)
     scan.visit(ctx.tree)
-    return scan.findings
+    return scan.findings + _check_grv_cache(ctx)
