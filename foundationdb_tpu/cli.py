@@ -1,12 +1,17 @@
 """Interactive CLI (ref: fdbcli/fdbcli.actor.cpp — the operator shell).
 
     python -m foundationdb_tpu.cli
+    python -m foundationdb_tpu.cli --cluster-file <cluster.json>
 
-Runs an in-process SHARDED cluster (4 storage servers, double
-replication, data distribution running) on a real-time event loop and
-evaluates one command per line — so the management verbs operate on a
-real fleet. Keys/values accept Python bytes-literal escapes
-(e.g. prefix\\x00suffix).
+Without --cluster-file, runs an in-process SHARDED cluster (4 storage
+servers, double replication, data distribution running) on a real-time
+event loop and evaluates one command per line — so the management verbs
+operate on a real fleet. WITH --cluster-file it ATTACHES to a DEPLOYED
+multiprocess cluster over the control RPCs: data verbs ride the normal
+client connection, `status`/`recruitment` pull the controller's
+documents over WLTOKEN_CONTROLLER (the same shell, anywhere — ref:
+fdbcli connecting through fdb.cluster). Keys/values accept Python
+bytes-literal escapes (e.g. prefix\\x00suffix).
 
 Commands (the fdbcli core surface):
     get <key>                     read a key
@@ -14,7 +19,10 @@ Commands (the fdbcli core surface):
     clear <key>                   clear a key
     clearrange <begin> <end>      clear a range
     getrange <begin> <end> [lim]  list key/value pairs
-    status [json]                 cluster status (summary or full JSON)
+    status [json]                 cluster status (summary or full JSON;
+                                  attached: served by the controller)
+    recruitment [json]            worker registry + recruitment stalls
+                                  (attached: the controller's registry)
     configure <k=v> ...           set replicated configuration (\xff/conf)
     configuration                 show replicated configuration
     exclude [tag ...]             exclude storage servers (no args: list);
@@ -55,7 +63,30 @@ def _p(raw: bytes) -> str:
 
 
 class Cli:
-    def __init__(self, sharded: bool = True):
+    def __init__(self, sharded: bool = True, cluster_file: str = None):
+        self.cluster_file = cluster_file
+        self.write_mode = False
+        self._transport = None
+        self._ctrl = None
+        if cluster_file is not None:
+            # ATTACH to a deployed multiprocess cluster: real transport,
+            # client endpoints from the shared cluster file, and a
+            # control stream to the controller's registry endpoint.
+            from .cluster import multiprocess as mp
+            from .net.transport import real_loop_with_transport
+
+            self.loop, self._transport = real_loop_with_transport()
+            self._ctx = loop_context(self.loop)
+            self._ctx.__enter__()
+            info = self._run(self._wait_deployment(), timeout=60)
+            self.db: Database = mp.connect(self._transport, cluster_file)
+            ctrl_addr = info.get("controller") or info["txn"]
+            self._ctrl = self._transport.remote_stream(
+                ctrl_addr, mp.WLTOKEN_CONTROLLER
+            )
+            self.cluster = None
+            self.dd = None
+            return
         self.loop = EventLoop()  # real clock: an interactive tool
         self._ctx = loop_context(self.loop)
         self._ctx.__enter__()
@@ -73,11 +104,34 @@ class Cli:
             self.cluster = LocalCluster().start()
             self.dd = None
         self.db: Database = self.cluster.database()
-        self.write_mode = False
 
-    def _run(self, coro):
+    async def _wait_deployment(self) -> dict:
+        """Poll the cluster file until the deployment's client-facing
+        keys exist (txn publishes after its first recovery)."""
+        from .cluster.multiprocess import read_cluster_file
+        from .core.runtime import current_loop
+
+        loop = current_loop()
+        while True:
+            info = read_cluster_file(self.cluster_file) or {}
+            if "txn" in info and "storage" in info:
+                return info
+            await loop.delay(0.2)
+
+    def _run(self, coro, timeout: float = 30):
         task = self.loop.spawn(coro, name="cli")
-        return self.loop.run_until(task.done, timeout_sim_seconds=30)
+        return self.loop.run_until(task.done, timeout_sim_seconds=timeout)
+
+    def _controller_rpc(self, req):
+        """One request/reply against the controller endpoint (attached
+        mode only)."""
+        from .core.actors import timeout_error
+
+        async def rpc():
+            self._ctrl.send(req)
+            return await timeout_error(req.reply.future, 15)
+
+        return self._run(rpc())
 
     def execute(self, line: str) -> str:
         parts = line.strip().split()
@@ -132,7 +186,12 @@ class Cli:
             lines = [f"`{_p(k)}' is `{_p(v)}'" for k, v in rows]
             return "\n".join(lines) if lines else "Range empty"
         if cmd == "status":
-            st = cluster_status(self.cluster)
+            if self._ctrl is not None:
+                from .cluster.interfaces import ClusterStatusRequest
+
+                st = self._controller_rpc(ClusterStatusRequest())
+            else:
+                st = cluster_status(self.cluster)
             if args and args[0] == "json":
                 return json.dumps(st, indent=2, default=str)
             c = st["cluster"]
@@ -143,8 +202,45 @@ class Cli:
                 f"Committed:      {w['committed']} txns "
                 f"({w['conflicted']} conflicted)\n"
                 f"Roles:          "
-                + ", ".join(r["role"] for r in c["roles"])
+                + (", ".join(r["role"] for r in c["roles"]) or "(none)")
             )
+        if cmd == "recruitment":
+            if self._ctrl is None:
+                topo = getattr(self.cluster, "sim_topology", None)
+                if topo is None:
+                    return ("This deployment has no worker registry "
+                            "(embedded in-process cluster); attach to a "
+                            "deployed cluster with --cluster-file")
+                rec = topo.registry.status()
+            else:
+                from .cluster.interfaces import RecruitmentStatusRequest
+
+                rec = self._controller_rpc(RecruitmentStatusRequest())
+            if args and args[0] == "json":
+                return json.dumps(rec, indent=2, default=str)
+            lines = []
+            state = rec.get("recovery_state")
+            if state:
+                lines.append(f"Recovery state: {state}")
+            for w in rec["workers"]:
+                lines.append(
+                    f"worker {w['id']:<28} class={w['class']:<10} "
+                    f"machine={w['machine'] or '-':<8} "
+                    f"{'live' if w['live'] else 'DEAD'} "
+                    f"(beat {w['age_s']}s ago)"
+                )
+            for role, wid in sorted(rec.get("recruited", {}).items()):
+                lines.append(f"recruited {role} -> {wid}")
+            stalls = rec.get("stalls", {})
+            if stalls:
+                for role, since in sorted(stalls.items()):
+                    lines.append(
+                        f"STALL recruiting_{role} for {since}s "
+                        "(waiting for a candidate worker to register)"
+                    )
+            else:
+                lines.append("No recruitment stalls.")
+            return "\n".join(lines)
         if cmd == "configure":
             self._need_write_mode()
             from .cluster import management
@@ -180,6 +276,9 @@ class Cli:
             self._run(management.include_servers(self.db, tags))
             return "Included"
         if cmd == "coordinators":
+            if self.cluster is None:
+                return ("Coordinators live in the txn host's datadir on "
+                        "a deployed cluster; see `status json`")
             coords = getattr(self.cluster, "coordinators", None)
             if not coords:
                 return ("This deployment runs without a coordination "
@@ -191,7 +290,7 @@ class Cli:
         if cmd == "throttle":
             rk = getattr(self.cluster, "ratekeeper", None)
             if rk is None:
-                return "No ratekeeper in this deployment"
+                return "No ratekeeper reachable from this shell"
             if not args or args[0] == "off":
                 rk.manual_limit = None
                 return "Throttle cleared (automatic rate control)"
@@ -226,13 +325,27 @@ class Cli:
         return f"ERROR: unknown command `{cmd}' (try help)"
 
     def close(self):
-        self.cluster.stop()
+        if self.cluster is not None:
+            self.cluster.stop()
+        if self._transport is not None:
+            self._transport.close()
         self._ctx.__exit__(None, None, None)
 
 
-def main() -> None:
-    cli = Cli()
-    print("fdbtpu-cli: sharded cluster started: 4 storage / double replication (type help)")
+def main(argv=None) -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="foundationdb_tpu.cli")
+    ap.add_argument("-C", "--cluster-file",
+                    help="attach to a DEPLOYED multiprocess cluster via "
+                         "its shared cluster file instead of starting an "
+                         "embedded one")
+    args = ap.parse_args(argv)
+    cli = Cli(cluster_file=args.cluster_file)
+    if args.cluster_file:
+        print(f"fdbtpu-cli: attached to {args.cluster_file} (type help)")
+    else:
+        print("fdbtpu-cli: sharded cluster started: 4 storage / double replication (type help)")
     try:
         while True:
             try:

@@ -208,11 +208,26 @@ class LeaderLease:
 
 class LeaderElection:
     """Lease-based election over the coordinated state (ref:
-    tryBecomeLeaderInternal's nominee + heartbeat loop)."""
+    tryBecomeLeaderInternal's nominee + heartbeat loop).
 
-    def __init__(self, cstate: CoordinatedState, lease_seconds: float = 1.0):
+    The default lease rides the failure-detection horizon
+    (FAILURE_TIMEOUT_DELAY, read live): the controller seat and the
+    worker leases it arbitrates recruitment by should age on the same
+    clock — a takeover faster than failure detection would recruit
+    against a registry that still believes the old world."""
+
+    def __init__(self, cstate: CoordinatedState,
+                 lease_seconds: Optional[float] = None):
         self.cstate = cstate
-        self.lease_seconds = lease_seconds
+        self._lease_seconds = lease_seconds
+
+    @property
+    def lease_seconds(self) -> float:
+        if self._lease_seconds is not None:
+            return self._lease_seconds
+        from ..core.knobs import SERVER_KNOBS
+
+        return SERVER_KNOBS.FAILURE_TIMEOUT_DELAY
 
     def try_become_leader(self, who: str) -> Optional[LeaderLease]:
         """Claim leadership if the seat is free or the lease lapsed.

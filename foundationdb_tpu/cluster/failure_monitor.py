@@ -58,13 +58,25 @@ class FailureStateRequest:
 
 class FailureDetectionServer:
     """Hosted by the controller (ref: failureDetectionServer,
-    ClusterController.actor.cpp:1296)."""
+    ClusterController.actor.cpp:1296).
 
-    def __init__(self):
+    `timeout` overrides the failure horizon (float, or a callable read
+    per sweep so knob changes land live): the worker registry leases
+    workers at WORKER_LEASE_TIMEOUT through exactly this server, while
+    the default horizon stays FAILURE_TIMEOUT_DELAY."""
+
+    def __init__(self, timeout=None):
         self.stream: PromiseStream = PromiseStream()
+        self._timeout = timeout
         self._last_beat: dict[str, float] = {}
         self._state = AsyncVar(FailureMonitorState())
         self._tasks = []
+
+    def _timeout_s(self) -> float:
+        t = self._timeout
+        if callable(t):
+            return t()
+        return t if t is not None else SERVER_KNOBS.FAILURE_TIMEOUT_DELAY
 
     @property
     def state(self) -> FailureMonitorState:
@@ -84,9 +96,7 @@ class FailureDetectionServer:
 
     async def _serve_one(self, req):
         if isinstance(req, HeartbeatRequest):
-            self._last_beat[req.process] = current_loop().now()
-            if req.process in self.state.failed:
-                self._mark(req.process, failed=False)
+            self.beat(req.process)
             return True
         if isinstance(req, FailureStateRequest):
             if req.known_generation == self.state.generation:
@@ -94,6 +104,16 @@ class FailureDetectionServer:
                 await self._state.on_change()
             return self.state
         raise TypeError(f"unknown failure-monitor request {type(req)}")
+
+    def beat(self, process: str) -> None:
+        """One liveness beat, callable in-process too (the worker
+        registry feeds registrations through here)."""
+        self._last_beat[process] = current_loop().now()
+        if process in self.state.failed:
+            self._mark(process, failed=False)
+
+    def is_failed(self, process: str) -> bool:
+        return process in self.state.failed
 
     def _mark(self, process: str, failed: bool) -> None:
         cur = self.state
@@ -110,8 +130,8 @@ class FailureDetectionServer:
     async def _sweep_loop(self):
         loop = current_loop()
         while True:
-            await loop.delay(SERVER_KNOBS.FAILURE_TIMEOUT_DELAY / 2)
-            deadline = loop.now() - SERVER_KNOBS.FAILURE_TIMEOUT_DELAY
+            await loop.delay(self._timeout_s() / 2)
+            deadline = loop.now() - self._timeout_s()
             for process, beat in self._last_beat.items():
                 if beat < deadline and process not in self.state.failed:
                     self._mark(process, failed=True)

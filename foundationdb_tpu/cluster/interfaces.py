@@ -127,6 +127,39 @@ class TLogCommitRequest:
 
 
 @dataclass
+class RegisterWorkerRequest:
+    """Worker -> controller registration (ref: RegisterWorkerRequest,
+    fdbserver/WorkerInterface.actor.h; worker.actor.cpp:481
+    registrationClient). Re-sent forever on the heartbeat interval —
+    registration IS the liveness lease beat. The reply carries the
+    interval (seconds) the controller leases against."""
+
+    worker_id: str
+    process_class: str
+    address: str = ""
+    machine_id: str = ""
+    reply: Promise = field(default_factory=Promise)
+
+
+@dataclass
+class RecruitmentStatusRequest:
+    """Operator shell -> controller: the worker registry + any active
+    recruitment stalls (the `recruitment` verb of cli.py)."""
+
+    reply: Promise = field(default_factory=Promise)
+
+
+@dataclass
+class ClusterStatusRequest:
+    """Operator shell -> controller: the full status-json document of a
+    DEPLOYED cluster over the control RPCs — what `cli.py
+    --cluster-file` renders (ref: the cluster controller assembling
+    status for fdbcli, Status.actor.cpp)."""
+
+    reply: Promise = field(default_factory=Promise)
+
+
+@dataclass
 class ResolveTransactionBatchRequest:
     """(ref: ResolveTransactionBatchRequest, ResolverInterface.h:70).
 
@@ -176,6 +209,9 @@ def _register_wire_types() -> None:
         WatchValueRequest,
         TLogCommitRequest,
         ResolveTransactionBatchRequest,
+        RegisterWorkerRequest,
+        RecruitmentStatusRequest,
+        ClusterStatusRequest,
         KeyRange,
         TxnConflictInfo,
     ):

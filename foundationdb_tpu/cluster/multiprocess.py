@@ -57,6 +57,7 @@ from .log_system import TaggedMutation
 WLTOKEN_LOCATION = 13
 WLTOKEN_COMMIT_BATCH = 14    # columnar CommitBatchRequest (commit_wire.py)
 WLTOKEN_TXN_STATUS = 15      # TxnStatusRequest: commit-plane status pull
+WLTOKEN_CONTROLLER = 16      # worker registration + status/recruitment pulls
 WLTOKEN_LOG_BASE = 100       # +2*i commit, +2*i+1 control
 WLTOKEN_STORAGE_BASE = 300   # +2*tag read, +2*tag+1 control
 WLTOKEN_RESOLVER_BASE = 500  # host control; +1+idx per-resolver resolve
@@ -323,6 +324,33 @@ def log_host_classes(n_log_hosts: int) -> list[str]:
     if n_log_hosts <= 1:
         return ["log"]
     return [f"log{j}" for j in range(n_log_hosts)]
+
+
+def resolver_host_classes(n_resolver_hosts: int) -> list[str]:
+    """Process-class names of the resolver hosts (same numbering scheme
+    as the log failure domains). Recruitment picks ONE live host per
+    generation via the worker registry — extra hosts are warm spares the
+    controller fails over to when the serving host's lease lapses."""
+    if n_resolver_hosts <= 1:
+        return ["resolver"]
+    return [f"resolver{j}" for j in range(n_resolver_hosts)]
+
+
+def is_resolver_class(role_class: str) -> bool:
+    return role_class == "resolver" or (
+        role_class.startswith("resolver") and role_class[8:].isdigit()
+    )
+
+
+def machine_for_class(spec: dict, role_class: str) -> str:
+    """The failure-domain id of a role class: the spec's `machines`
+    stanza ({machine_id: [class, ...]}) when present, else the class is
+    its own single-process machine (the historical layout)."""
+    machines = spec.get("machines") or {}
+    for mid in sorted(machines):
+        if role_class in machines[mid]:
+            return mid
+    return role_class
 
 
 def log_owner(log_id: int, n_log_hosts: int) -> int:
@@ -994,7 +1022,8 @@ class TxnHost:
     one process (ref: the cluster-controller/master machine class)."""
 
     def __init__(self, transport, datadir: Optional[str], spec: dict,
-                 log_addrs, storage_addr: str, resolver_addr=None):
+                 log_addrs, storage_addr: str, resolver_addr=None,
+                 want_resolvers: Optional[bool] = None):
         from .coordination import (
             CoordinatedState,
             CoordinatorRegister,
@@ -1002,6 +1031,7 @@ class TxnHost:
             LeaderElection,
         )
         from .recovery import EndpointRef
+        from .recruitment import WorkerRegistry
         from .sharded_cluster import derive_layout
         from .shards import ShardMap
 
@@ -1023,10 +1053,23 @@ class TxnHost:
                 bytes([(256 * (i + 1)) // self.n_resolvers])
             )
         self.balancer = None
-        self._resolver_ctrl = (
-            transport.remote_stream(resolver_addr, WLTOKEN_RESOLVER_BASE)
-            if resolver_addr is not None else None
-        )
+        # The controller's worker registry: resolver hosts (and every
+        # other role host) register over WLTOKEN_CONTROLLER; recovery
+        # recruits the best-fitness live worker instead of a spec-frozen
+        # address. A legacy explicit resolver_addr seeds one
+        # registration (it must keep heartbeating to stay a candidate).
+        self.registry = WorkerRegistry()
+        self.want_resolvers = bool(want_resolvers) or resolver_addr is not None
+        self.recovery_state = "booting"
+        self.recruited: dict[str, str] = {}   # role -> serving worker_id
+        if resolver_addr is not None:
+            # Pinned: a directly-constructed TxnHost has no registration
+            # loop refreshing this entry — the explicit address is the
+            # caller taking liveness into its own hands.
+            self.registry.register(
+                f"resolver@{resolver_addr}", process_class="resolver",
+                address=resolver_addr, pinned=True,
+            )
         self.log_system = RemoteLogSystem(
             transport, log_addrs, self.n_logs,
             log_replication=kw["log_replication"], topology=kw["topology"],
@@ -1063,7 +1106,6 @@ class TxnHost:
         self.cstate = CoordinatedState(self.coordinators, key="generation")
         self.election = LeaderElection(
             CoordinatedState(self.coordinators, key="leader"),
-            lease_seconds=1.0,
         )
         self.generation = 0
         self.recoveries_done = 0
@@ -1112,6 +1154,21 @@ class TxnHost:
             self._status_s, self._serve_txn_status,
             TaskPriority.DEFAULT, "txnStatus",
         ))
+        # Controller endpoint: worker registration/heartbeats + the
+        # operator shell's status/recruitment pulls (cli --cluster-file).
+        self._controller_s: PromiseStream = PromiseStream()
+        transport.register_endpoint(self._controller_s, WLTOKEN_CONTROLLER)
+        self._tasks.add(serve_requests(
+            self._controller_s, self._serve_controller,
+            TaskPriority.COORDINATION, "controllerRegistry",
+        ))
+        self.registry.start()
+        # The controller's own process is a worker too (class txn hosts
+        # the transaction bundle); pinned — its lease is its life.
+        self.registry.register(
+            f"txn@{transport.local_address}", process_class="txn",
+            address=transport.local_address, pinned=True,
+        )
 
     # -- batched commits (columnar client->proxy hop) --
     async def _serve_commit_batch(self, req):
@@ -1192,6 +1249,33 @@ class TxnHost:
             },
         }
 
+    # -- controller registry endpoint (worker registration + operator pulls) --
+    async def _serve_controller(self, req):
+        from .interfaces import (
+            ClusterStatusRequest,
+            RecruitmentStatusRequest,
+            RegisterWorkerRequest,
+        )
+
+        if isinstance(req, RegisterWorkerRequest):
+            return self.registry.register(
+                req.worker_id, process_class=req.process_class,
+                address=req.address, machine_id=req.machine_id,
+            )
+        if isinstance(req, RecruitmentStatusRequest):
+            return self._recruitment_status()
+        if isinstance(req, ClusterStatusRequest):
+            from .status import multiprocess_status
+
+            return multiprocess_status(self)
+        raise TypeError(f"unknown controller request {type(req)}")
+
+    def _recruitment_status(self) -> dict:
+        st = self.registry.status()
+        st["recruited"] = dict(sorted(self.recruited.items()))
+        st["recovery_state"] = self.recovery_state
+        return st
+
     # -- read forwarding (by-key routing like the client's location cache) --
     async def _forward_read(self, req):
         if isinstance(req, GetValueRequest):
@@ -1254,12 +1338,28 @@ class TxnHost:
         from .resolver_role import ResolverRole
         from ..resolver.factory import make_conflict_set
 
+        from .recruitment import RecruitmentStalled
+
+        self.recovery_state = "locking_logs"
         generation = _bump_generation(self.cstate)
-        recovery_version, received = await self.log_system.lock(generation)
+        try:
+            recovery_version, received = await self.log_system.lock(
+                generation
+            )
+        except OperationFailed as e:
+            # A log host beyond the replication budget is unreachable.
+            # Park as a NAMED stall (status json shows recruiting_log)
+            # and resume the instant a log worker (re)registers — never
+            # a hot crash loop against a dead quorum.
+            self.recovery_state = "recruiting_log"
+            self.registry.note_stall("log", detail=str(e))
+            raise RecruitmentStalled("log", str(e)) from e
+        self.registry.note_resumed("log")
         # Every storage must CONFIRM its rollback before the new
         # generation starts: an un-rolled-back replica above the quorum
         # truncation would diverge from its team. An unreachable storage
-        # host fails THIS recovery attempt; the controller retries.
+        # host parks THIS recovery as a named stall; the controller
+        # resumes it when a storage worker registers.
         for tag, ctrl in self.storage_ctrl.items():
             for attempt in range(3):
                 req = StorageRollbackRequest(recovery_version)
@@ -1270,10 +1370,16 @@ class TxnHost:
                 if got is not _LOST:
                     break
             else:
-                raise OperationFailed(
-                    f"storage {tag} did not confirm rollback to "
-                    f"{recovery_version}"
+                self.recovery_state = "recruiting_storage"
+                self.registry.note_stall(
+                    "storage", detail=f"storage {tag} unreachable"
                 )
+                raise RecruitmentStalled(
+                    "storage",
+                    f"storage {tag} did not confirm rollback to "
+                    f"{recovery_version}",
+                )
+        self.registry.note_resumed("storage")
         start_version = max(recovery_version, received)
         await self.log_system.skip_to(start_version)
 
@@ -1285,23 +1391,43 @@ class TxnHost:
         self.generation = generation
         self.master = Master(init_version=start_version)
         resolvers = resolver_config = None
-        if self.resolver_addr is not None:
-            # Recruit the remote per-generation resolver fleet (an
-            # unreachable resolver host fails THIS attempt; the controller
-            # retries — same contract as the storage rollback confirms).
+        if self.want_resolvers:
+            # RECRUIT the resolver host: rank the live registered
+            # workers by fitness (recruitment.select_workers) instead of
+            # a spec-frozen address; no live candidate parks this
+            # recovery in recruiting_resolver until one registers (ref:
+            # the master's InitializeResolver dispatch onto controller-
+            # chosen workers).
+            from .recruitment import Fitness
             from .resolution import ResolutionBalancer, ResolverConfig
 
+            self.recovery_state = "recruiting_resolver"
+            # BEST fitness only: a role host serves only its own class's
+            # endpoints, so only resolver-class workers can host the
+            # fleet (the ladder still orders multiple resolver hosts).
+            worker = self.registry.recruit(
+                "resolver", 1, max_fitness=Fitness.BEST
+            )[0]
             init = InitResolversRequest(generation, start_version)
-            self._resolver_ctrl.send(init)
+            ctrl = self.transport.remote_stream(
+                worker.address, WLTOKEN_RESOLVER_BASE
+            )
+            ctrl.send(init)
             got = await timeout(
                 init.reply.future, SERVER_KNOBS.ROLE_RPC_TIMEOUT, _LOST
             )
             if got is _LOST:
+                # Lease said live but the host is gone (mid-SIGKILL):
+                # forget it so the next attempt ranks the survivors; the
+                # worker re-registers on its next beat if it was a blip.
+                self.registry.forget(worker.worker_id)
                 raise OperationFailed(
-                    "resolver host did not confirm recruitment"
+                    f"resolver host {worker.worker_id} did not confirm "
+                    "recruitment"
                 )
+            self.recruited["resolver"] = worker.worker_id
             resolvers = [
-                RemoteResolver(self.transport, self.resolver_addr, i,
+                RemoteResolver(self.transport, worker.address, i,
                                generation=generation)
                 for i in range(self.n_resolvers)
             ]
@@ -1350,6 +1476,7 @@ class TxnHost:
             TaskPriority.DEFAULT, name="metadataRebuild",
         ))
         self.recoveries_done += 1
+        self.recovery_state = "fully_recovered"
         TraceEvent("RecoveryComplete").detail(
             "Generation", generation
         ).detail("RecoveryVersion", recovery_version).detail(
@@ -1448,8 +1575,12 @@ class TxnHost:
     def start_controller(self, name: str = "cc0") -> None:
         """Same election + health-probe + recover loop as the in-process
         tiers (RecoverableCluster.start_controller), with the recovery
-        steps awaited over RPC."""
+        steps awaited over RPC and recruitment stalls PARKED: a
+        RecruitmentStalled recovery waits on the registry's registration
+        event (bounded by RECRUITMENT_STALL_RETRY_DELAY) instead of
+        crash-looping, and resumes the instant a worker registers."""
         from ..core.errors import ActorCancelled
+        from .recruitment import RecruitmentStalled
 
         async def controller():
             loop = current_loop()
@@ -1476,6 +1607,11 @@ class TxnHost:
                         await self.recover()
                 except (ActorCancelled, GeneratorExit):
                     raise
+                except RecruitmentStalled:
+                    # Parked, not errored: the stall is already recorded
+                    # (status json shows recruiting_<role>); wake on the
+                    # next registration or the stall-retry delay.
+                    await self.registry.wait_for_worker()
                 except BaseException as e:  # noqa: BLE001
                     TraceEvent("ControllerError", severity=30).error(e).log()
 
@@ -1487,11 +1623,24 @@ class TxnHost:
     async def _txn_system_healthy(self) -> bool:
         from .recovery import RecoverableCluster
 
+        # A recruited worker whose lease lapsed takes its role down with
+        # it (the SIGKILLed resolver host): unhealthy regardless of what
+        # the commit probe says — the commit path's errored replies would
+        # otherwise read as "pipeline answers" forever (ref: the
+        # controller's WaitFailureClient on every recruited interface).
+        for role in sorted(self.recruited):
+            wid = self.recruited[role]
+            if not self.registry.is_live(wid):
+                TraceEvent("RecruitedWorkerFailed", severity=30).detail(
+                    "Role", role
+                ).detail("Worker", wid).log()
+                return False
         return await RecoverableCluster._txn_system_healthy(self)
 
     def stop(self) -> None:
         self._controllers.cancel_all()
         self._stop_transaction_system()
+        self.registry.stop()
         self._tasks.cancel_all()
 
 
@@ -1532,12 +1681,56 @@ def connect(transport, cluster_file: str):
 # ---------------------------------------------------------------------------
 # process entrypoints (server.py -r fdbd --class ...)
 # ---------------------------------------------------------------------------
+def start_worker_registration(transport, cluster_file: str, role_class: str,
+                              machine_id: str, stopping):
+    """Register this host with the controller on the heartbeat interval
+    (ref: worker.actor.cpp:481 registrationClient — workers re-register
+    forever; registration IS the lease heartbeat). The controller
+    address comes from the cluster file's `controller` key, which the
+    txn host publishes BEFORE its first recovery so a stalled boot
+    recruitment can be un-stalled by exactly this loop."""
+    from .interfaces import RegisterWorkerRequest
+
+    async def reg():
+        loop = current_loop()
+        worker_id = f"{role_class}@{transport.local_address}"
+        ctrl = ctrl_addr = None
+        while not stopping():
+            info = read_cluster_file(cluster_file) or {}
+            addr = info.get("controller") or info.get("txn")
+            if addr is None:
+                await loop.delay(0.1)
+                continue
+            if addr != ctrl_addr:
+                ctrl = transport.remote_stream(addr, WLTOKEN_CONTROLLER)
+                ctrl_addr = addr
+            req = RegisterWorkerRequest(
+                worker_id, role_class, transport.local_address, machine_id
+            )
+            ctrl.send(req)
+            # The reply carries the controller's expected interval; a
+            # lost reply just means beating again at our own cadence.
+            await timeout(req.reply.future,
+                          SERVER_KNOBS.WORKER_HEARTBEAT_INTERVAL, _LOST)
+            await loop.delay(
+                SERVER_KNOBS.WORKER_HEARTBEAT_INTERVAL
+                * (0.75 + 0.5 * loop.random.random01())
+            )
+
+    return spawn(reg(), TaskPriority.COORDINATION,
+                 name=f"register:{role_class}")
+
+
 def run_role_host(role_class: str, cluster_file: str, datadir: str,
-                  port: int = 0, ready=None, stop_event=None) -> None:
+                  port: int = 0, ready=None, stop_event=None,
+                  machine_id: str = "") -> None:
     """Run one role host on a real-clock loop until stop_event. The host
     merges its listen address into the cluster file; hosts needing peers
     wait for the peers' addresses to appear (discovery via the shared
-    file, the reference's cluster-file contract)."""
+    file, the reference's cluster-file contract). Every host registers
+    with the controller (worker registry) under `machine_id` — its
+    shared-fate failure domain (--machine-id / the spec's `machines`
+    stanza)."""
     from ..net.transport import real_loop_with_transport
 
     spec = None
@@ -1592,8 +1785,13 @@ def run_role_host(role_class: str, cluster_file: str, datadir: str,
                 addrs.append(a)
             return addrs
 
+        mid = machine_id or machine_for_class(spec, role_class)
+
         async def main():
+            from .recruitment import RecruitmentStalled
+
             host = None
+            reg_task = None
             if role_class in log_keys:
                 idx = log_keys.index(role_class)
                 host = LogHost(transport, f"{datadir}/log",
@@ -1605,31 +1803,36 @@ def run_role_host(role_class: str, cluster_file: str, datadir: str,
                     return
                 host = StorageHost(transport, f"{datadir}/storage", spec,
                                    log_addrs)
-            elif role_class == "resolver":
+            elif is_resolver_class(role_class):
                 host = ResolverHost(transport, spec)
             elif role_class == "txn":
                 log_addrs = await _all_log_addrs()
                 storage_addr = await _wait_for(cluster_file, "storage",
                                                stopping)
-                resolver_addr = None
-                if "resolver" in spec.get("ports", {}):
-                    resolver_addr = await _wait_for(
-                        cluster_file, "resolver", stopping
-                    )
-                    if resolver_addr is None:
-                        return
                 if log_addrs is None or storage_addr is None:
                     return
+                want_res = any(is_resolver_class(c)
+                               for c in spec.get("ports", {}))
                 host = TxnHost(transport, f"{datadir}/txn", spec,
                                log_addrs, storage_addr,
-                               resolver_addr=resolver_addr)
-                # Peers may still be coming up (or restarting): the boot
-                # recovery retries until the log quorum answers — but a
-                # SIGTERM must still win (peers may never come up).
+                               want_resolvers=want_res)
+                # Publish the CONTROLLER address before the boot
+                # recovery: resolver hosts must be able to REGISTER with
+                # the worker registry to un-stall it (the `txn` key
+                # stays recovery-gated below for the client contract).
+                write_cluster_file(
+                    cluster_file, {"controller": transport.local_address}
+                )
+                # Peers may still be coming up (or restarting): a stalled
+                # recruitment parks on the registration event; any other
+                # boot failure retries — but a SIGTERM must still win
+                # (peers may never come up).
                 while not stopping():
                     try:
                         await host.recover()
                         break
+                    except RecruitmentStalled:
+                        await host.registry.wait_for_worker()
                     except BaseException as e:  # noqa: BLE001
                         TraceEvent("BootRecoveryRetry",
                                    severity=30).error(e).log()
@@ -1637,6 +1840,14 @@ def run_role_host(role_class: str, cluster_file: str, datadir: str,
                 host.start_controller("cc0")
             else:
                 raise ValueError(f"unknown process class {role_class!r}")
+            if role_class != "txn":
+                # Every non-controller host heartbeats into the worker
+                # registry (class + machine/failure-domain id): the
+                # registry is how recovery finds recruits and how their
+                # death is detected (lease lapse).
+                reg_task = start_worker_registration(
+                    transport, cluster_file, role_class, mid, stopping
+                )
             # Publish the address only once the endpoints are LIVE — a
             # peer reading the cluster file must never race this host's
             # registration (txn publishes after its first recovery, so a
@@ -1660,10 +1871,100 @@ def run_role_host(role_class: str, cluster_file: str, datadir: str,
                         break
                     await current_loop().delay(0.05)
             finally:
+                if reg_task is not None:
+                    reg_task.cancel()
                 host.stop()
 
         loop.run(main())
         transport.close()
+
+
+def run_machine(machine_id: str, cluster_file: str, datadir: str,
+                stop_event=None) -> int:
+    """Run EVERY process class of one spec machine as child OS processes
+    sharing THIS launcher's process group — the multiprocess tier's
+    shared-fate failure domain, mirroring sim/topology.SimMachine (one
+    kill takes every resident role at one instant; ref: sim2's
+    MachineInfo + fdbmonitor supervising a machine's fdbd fleet).
+
+    Shared fate holds in BOTH directions: SIGKILL of the process group
+    (the generated `<datadir>/kill.sh`) destroys the launcher and every
+    role host at one instant, and any single resident process dying
+    takes the rest of the machine down with it. Returns 0 on clean stop,
+    else the first dead child's exit status."""
+    import subprocess
+    import sys as _sys
+    import time as _time
+
+    spec = None
+    while spec is None and not (stop_event is not None
+                                and stop_event.is_set()):
+        info = read_cluster_file(cluster_file)
+        spec = (info or {}).get("spec")
+        if spec is None:
+            # fdblint: allow[det-sleep] -- real-OS machine launcher: polls the shared cluster file before any event loop exists; this entry point only runs on the real-clock multiprocess tier.
+            _time.sleep(0.05)
+    if spec is None:
+        return 0
+    machines = spec.get("machines") or {}
+    if machine_id not in machines:
+        raise ValueError(
+            f"machine {machine_id!r} not in the spec's machines stanza "
+            f"(have: {sorted(machines)})"
+        )
+    classes = list(machines[machine_id])
+    os.makedirs(datadir, exist_ok=True)
+    # The shared-fate kill script: kill -9 of the GROUP is the machine
+    # dying — launcher and every resident role host at one instant.
+    pgid = os.getpgid(0)
+    kill_sh = os.path.join(datadir, "kill.sh")
+    with open(kill_sh, "w") as f:
+        f.write(
+            "#!/bin/sh\n"
+            f"# shared-fate kill of machine {machine_id!r}: every role\n"
+            "# host shares the launcher's process group.\n"
+            f"kill -9 -- -{pgid}\n"
+        )
+    os.chmod(kill_sh, 0o755)
+    procs = []
+    for cls in classes:
+        # NO new session: children inherit the launcher's process group,
+        # which IS the machine's failure domain.
+        procs.append(subprocess.Popen(
+            [_sys.executable, "-m", "foundationdb_tpu.server", "-r",
+             "fdbd", "-c", cls, "-C", cluster_file,
+             "-d", os.path.join(datadir, cls), "--machine-id", machine_id],
+        ))
+    try:
+        while True:
+            if stop_event is not None and stop_event.is_set():
+                for p in procs:
+                    p.terminate()
+                for p in procs:
+                    try:
+                        p.wait(timeout=20)
+                    except subprocess.TimeoutExpired:
+                        p.kill()
+                return 0
+            for p in procs:
+                code = p.poll()
+                if code is not None:
+                    # One resident died: the machine dies with it.
+                    for q in procs:
+                        if q.poll() is None:
+                            q.kill()
+                    for q in procs:
+                        try:
+                            q.wait(timeout=10)
+                        except subprocess.TimeoutExpired:
+                            pass
+                    return code or 1
+            # fdblint: allow[det-sleep] -- real-OS machine launcher supervision loop (no event loop in this process); multiprocess tier only.
+            _time.sleep(0.1)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
 
 
 async def _wait_for(cluster_file: str, key: str,

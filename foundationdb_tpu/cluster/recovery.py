@@ -135,12 +135,12 @@ class RecoverableCluster:
         self.cstate = CoordinatedState(self.coordinators, key="generation")
         self.election = LeaderElection(
             CoordinatedState(self.coordinators, key="leader"),
-            lease_seconds=1.0,
         )
         self.tlog = MemoryTLog(0)
         self.storage = StorageServer(self.tlog, 0)
         self.generation = 0
         self.recoveries_done = 0
+        self.recovery_state = "booting"
         self.master: Optional[Master] = None
         self.resolver: Optional[ResolverRole] = None
         self.proxy: Optional[CommitProxy] = None
@@ -196,6 +196,7 @@ class RecoverableCluster:
         """Steps 1-4 of the module docstring. Synchronous: every step is
         quorum arithmetic + object construction on the loop thread."""
 
+        self.recovery_state = "recovering"
         generation = _bump_generation(self.cstate)
         recovery_version = self.tlog.lock(generation)
         # The new generation's version chain must start above anything the
@@ -227,6 +228,7 @@ class RecoverableCluster:
         _send_recovery_txn(self.commit_ref, start_version)
         _seal_generation(self.cstate, generation, recovery_version)
         self.recoveries_done += 1
+        self.recovery_state = "fully_recovered"
         TraceEvent("RecoveryComplete").detail("Generation", generation).detail(
             "RecoveryVersion", recovery_version
         ).log()
@@ -240,6 +242,7 @@ class RecoverableCluster:
 
         async def controller():
             from ..core.errors import ActorCancelled
+            from .recruitment import RecruitmentStalled
 
             loop = current_loop()
             lease = None
@@ -272,6 +275,13 @@ class RecoverableCluster:
                         self._recover()
                 except (ActorCancelled, GeneratorExit):
                     raise
+                except RecruitmentStalled:
+                    # A parked recruitment is a NAMED state, not an
+                    # error: re-check at the stall-retry cadence (the
+                    # stall itself was already trace-logged once).
+                    await loop.delay(
+                        SERVER_KNOBS.RECRUITMENT_STALL_RETRY_DELAY
+                    )
                 except BaseException as e:  # noqa: BLE001
                     TraceEvent("ControllerError", severity=30).error(e).log()
 
@@ -375,10 +385,10 @@ class RecoverableShardedCluster:
         self.cstate = CoordinatedState(self.coordinators, key="generation")
         self.election = LeaderElection(
             CoordinatedState(self.coordinators, key="leader"),
-            lease_seconds=1.0,
         )
         self.generation = 0
         self.recoveries_done = 0
+        self.recovery_state = "booting"
         self.grv_ref = EndpointRef()
         self.commit_ref = EndpointRef()
         self.location_ref = EndpointRef()
@@ -466,6 +476,7 @@ class RecoverableShardedCluster:
         from .ratekeeper import Ratekeeper
         from .resolver_role import ResolverRole
 
+        self.recovery_state = "recovering"
         generation = _bump_generation(self.cstate)
         inner = self.inner
         recovery_version = inner.log_system.lock(generation)
@@ -574,6 +585,7 @@ class RecoverableShardedCluster:
             TaskPriority.DEFAULT,
             name="metadataRebuild",
         ))
+        self.recovery_state = "fully_recovered"
         TraceEvent("RecoveryComplete").detail("Generation", generation).detail(
             "RecoveryVersion", recovery_version
         ).detail("Sharded", True).log()

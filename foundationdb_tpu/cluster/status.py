@@ -160,6 +160,21 @@ def _sharded_status(cluster) -> dict[str, Any]:
     }
 
     st = _base_status(master, proxy)
+    state = getattr(cluster, "recovery_state", None)
+    if state:
+        st["cluster"]["recovery_state"] = {"name": state}
+    topo = getattr(cluster, "sim_topology", None)
+    if topo is not None:
+        # The recruitment lifecycle over the machine topology: registry
+        # workers (per-machine heartbeat leases) + any active stalls —
+        # an active stall IS the recovery state (recovery is parked in
+        # recruiting_<role> until a worker registers).
+        st["cluster"]["recruitment"] = topo.registry.status()
+        stalls = sorted(topo.registry.stalls)
+        if stalls:
+            st["cluster"]["recovery_state"] = {
+                "name": f"recruiting_{stalls[0]}"
+            }
     st["cluster"].update({
         "configuration": {
             "redundancy_mode": cluster.policy.describe(),
@@ -198,6 +213,61 @@ def _sharded_status(cluster) -> dict[str, Any]:
             ],
         }
     return st
+
+
+def multiprocess_status(host) -> dict[str, Any]:
+    """Status JSON of a DEPLOYED multiprocess cluster, assembled by the
+    controller (txn host) and served over ClusterStatusRequest — what an
+    operator shell attached via `cli.py --cluster-file` renders (ref:
+    the cluster controller assembling status for fdbcli,
+    Status.actor.cpp). Mid-stall there is no proxy/master: the document
+    still answers, recovery_state names the parked recruitment, and the
+    recruitment block shows the registry the stall is waiting on."""
+    loop = current_loop()
+    p = host.proxy
+    m = host.master
+    committed = p.txns_committed if p is not None else 0
+    conflicted = ((p.txns_conflicted + p.txns_too_old)
+                  if p is not None else 0)
+    roles: list[dict[str, Any]] = []
+    if m is not None:
+        roles.append({
+            "role": "master",
+            "latest_version": m.version,
+            "committed_version": m.committed.get(),
+        })
+    if p is not None:
+        roles.append(_proxy_role_status(p))
+    return {
+        "client": {
+            "database_status": {"available": p is not None},
+            "cluster_file": {"up_to_date": True},
+        },
+        "cluster": {
+            "generation": host.generation,
+            "recoveries_done": host.recoveries_done,
+            "recovery_state": {"name": host.recovery_state},
+            "latest_version": m.version if m is not None else 0,
+            "machine_time": loop.now(),
+            "simulated": loop.is_simulated(),
+            "workload": {
+                "transactions": {
+                    "committed": committed,
+                    "conflicted": conflicted,
+                    "started": committed + conflicted,
+                }
+            },
+            "recruitment": host._recruitment_status(),
+            "configuration": {
+                "logs": host.n_logs,
+                "storage_servers": host.n_storage,
+                "resolvers": host.n_resolvers,
+                "values": dict(host.config_values),
+                "excluded_servers": sorted(host.excluded),
+            },
+            "roles": roles,
+        },
+    }
 
 
 def _local_status(cluster) -> dict[str, Any]:

@@ -173,6 +173,17 @@ class ServerKnobs(Knobs):
         # Failure monitoring (ref: fdbserver/Knobs.cpp failure monitor)
         init("FAILURE_MIN_DELAY", 2.0)
         init("FAILURE_TIMEOUT_DELAY", 1.0)
+        # Worker recruitment (cluster/recruitment.py — the controller's
+        # worker registry): the registration/heartbeat cadence workers
+        # re-register at (registration IS the lease beat), the
+        # controller-side lease after which a silent worker leaves
+        # candidacy (the SIGKILLed role host's failover horizon), and how
+        # long a PARKED recruitment waits between candidate re-checks
+        # when no registration event wakes it first.
+        init("WORKER_HEARTBEAT_INTERVAL", 0.5, sim_random_range=(0.1, 1.0))
+        init("WORKER_LEASE_TIMEOUT", 2.0, sim_random_range=(0.5, 4.0))
+        init("RECRUITMENT_STALL_RETRY_DELAY", 0.5,
+             sim_random_range=(0.05, 1.0))
         # Data distribution (ref: fdbserver/Knobs.cpp DD section)
         init("MIN_SHARD_BYTES", 200000, sim_random_range=(5000, 200000))
         init("SHARD_BYTES_RATIO", 4)

@@ -154,8 +154,24 @@ def run_role_host(args) -> int:
 
     threading.Thread(target=announce, daemon=True).start()
     _run(args.process_class, args.cluster_file, args.datadir,
-         ready=ready, stop_event=stop)
+         ready=ready, stop_event=stop, machine_id=args.machine_id or "")
     return 0
+
+
+def run_machine_host(args) -> int:
+    """One MACHINE of a multi-process cluster (ref: fdbmonitor running a
+    machine's fdbd fleet): every process class the spec assigns to this
+    machine id, as one shared-fate process group."""
+    import signal
+    import threading
+
+    from .cluster.multiprocess import run_machine
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    return run_machine(args.machine, args.cluster_file, args.datadir,
+                       stop_event=stop)
 
 
 def main(argv=None) -> int:
@@ -173,8 +189,18 @@ def main(argv=None) -> int:
     ap.add_argument("-c", "--class", dest="process_class",
                     help="fdbd: host ONE role class of a multi-process "
                          "cluster: log / logN (one failure domain of an "
-                         "N-host log quorum) / storage / txn (requires "
-                         "--cluster-file and --datadir)")
+                         "N-host log quorum) / storage / resolver / "
+                         "resolverN / txn (requires --cluster-file and "
+                         "--datadir)")
+    ap.add_argument("-m", "--machine",
+                    help="fdbd: run EVERY process class the spec's "
+                         "`machines` stanza assigns to this machine id, "
+                         "as ONE shared-fate process group (requires "
+                         "--cluster-file and --datadir; a kill.sh is "
+                         "written into the datadir)")
+    ap.add_argument("--machine-id", default="",
+                    help="fdbd --class: the machine/failure-domain id "
+                         "reported in worker registration")
     ap.add_argument("-C", "--cluster-file",
                     help="shared cluster file (multi-process discovery)")
     ap.add_argument("-d", "--datadir", help="data directory (durable tier)")
@@ -190,8 +216,13 @@ def main(argv=None) -> int:
     if args.role == "cli":
         from .cli import main as cli_main
 
-        cli_main()
+        cli_main(["--cluster-file", args.cluster_file]
+                 if args.cluster_file else [])
         return 0
+    if args.machine:
+        if not args.cluster_file or not args.datadir:
+            ap.error("--machine requires --cluster-file and --datadir")
+        return run_machine_host(args)
     if args.process_class:
         if not args.cluster_file or not args.datadir:
             ap.error("--class requires --cluster-file and --datadir")

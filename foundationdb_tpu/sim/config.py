@@ -79,6 +79,13 @@ _KNOB_RANGES = [
     # the floating batch-close controller (proxy._AdaptiveBatchInterval).
     ("COMMIT_BATCH_BYTES_TARGET", "server", (1 << 12, 1 << 20)),
     ("COMMIT_TRANSACTION_BATCH_INTERVAL_MAX", "server", (0.001, 0.02)),
+    # r10: worker recruitment (cluster/recruitment.py) — the registry's
+    # heartbeat cadence vs lease horizon (draws where heartbeat > lease
+    # make leases flap, exercising the ranker's stale-lease demotion),
+    # and the parked-recruitment retry delay of stalled recoveries.
+    ("WORKER_HEARTBEAT_INTERVAL", "server", (0.1, 1.0)),
+    ("WORKER_LEASE_TIMEOUT", "server", (0.5, 4.0)),
+    ("RECRUITMENT_STALL_RETRY_DELAY", "server", (0.05, 1.0)),
 ]
 
 # Categorical knob draws (same subset-randomization policy as the ranges).

@@ -55,7 +55,10 @@ from __future__ import annotations
 from dataclasses import replace
 from typing import Optional
 
-from ..core.runtime import current_loop
+from ..cluster.recruitment import WorkerInfo, WorkerRegistry, select_workers
+from ..core.actors import ActorCollection
+from ..core.knobs import SERVER_KNOBS
+from ..core.runtime import TaskPriority, current_loop, spawn
 from ..core.trace import TraceEvent
 from .network import SimNetwork, SimProcess
 
@@ -86,6 +89,19 @@ class SimMachine:
         protectedAddresses — the simulator must not destroy the quorum
         that arbitrates recovery)."""
         return bool(self.coordinator_ids)
+
+    @property
+    def process_class(self) -> str:
+        """The machine's process class for fitness ranking, derived from
+        its STATEFUL residents (ref: SimulatedCluster assigning machine
+        classes): log machines rank as transaction-class hardware,
+        storage machines as storage, and role-free machines are unset —
+        the class the ranker prefers for stateless recruits."""
+        if self.log_ids or self.remote_log_ids:
+            return "log"
+        if self.storage_tags:
+            return "storage"
+        return "unset"
 
     def __repr__(self):
         roles = []
@@ -216,16 +232,51 @@ class MachineTopology:
                 k += 1
             for ci in range(len(coords)):
                 slots[ci % len(slots)].coordinator_ids.append(ci)
-        # Per-generation transaction roles start on machine 0 and are
-        # re-placed by every recovery (hook below).
+        # Worker registry + per-machine heartbeat actors: the SAME
+        # lease machinery the multiprocess controller recruits through
+        # (cluster/recruitment.py), so the heartbeat/lease knobs are
+        # exercised under simulation. Machine liveness stays the instant
+        # truth for placement (m.alive); a lapsed lease only DEMOTES a
+        # candidate (penalty), mirroring the reference preferring
+        # recently-heard-from workers.
+        self.registry = WorkerRegistry()
+        self._tasks = ActorCollection()
+        self.registry.start()
+        for m in self.machines:
+            self._tasks.add(spawn(
+                self._machine_heartbeat(m), TaskPriority.COORDINATION,
+                name=f"workerBeat:{m.name}",
+            ))
+        # Per-generation transaction roles are PLACED by the shared
+        # fitness ranker at boot and re-placed by every recovery (hook
+        # below) — the recruited-topology replacement of the historical
+        # "lowest-index live machine" rule.
         self.txn_machine = self.machines[0]
-        self.txn_machine.has_txn = True
+        self._place_txn_roles()
         self._install_recovery_hook()
         TraceEvent("SimTopologyBuilt").detail("Machines", n_machines).detail(
             "DCs", self.n_dcs
         ).detail(
             "Protected", sum(1 for m in self.machines if m.protected)
         ).log()
+
+    async def _machine_heartbeat(self, m: SimMachine) -> None:
+        """The worker registration loop (ref: worker.actor.cpp:481
+        registrationClient): while the machine is up it re-registers on
+        the heartbeat interval; a killed machine stops beating and its
+        lease lapses in the registry."""
+        loop = current_loop()
+        while True:
+            if m.alive:
+                self.registry.register(
+                    m.name, process_class=m.process_class,
+                    machine_id=m.name, dc=m.dc.index, index=m.index,
+                    penalty=1 if m.protected else 0,
+                )
+            await loop.delay(
+                SERVER_KNOBS.WORKER_HEARTBEAT_INTERVAL
+                * (0.75 + 0.5 * loop.random.random01())
+            )
 
     # -- wiring --
     def _install_recovery_hook(self) -> None:
@@ -241,17 +292,49 @@ class MachineTopology:
         cluster._recover = recover_and_place
 
     def _place_txn_roles(self) -> None:
-        """Each recovery recruits the new generation's roles onto a LIVE
-        machine (ref: the cluster controller recruiting on available
-        workers) — deterministically the lowest-index live machine, so
-        the same seed re-places identically."""
+        """Each recovery recruits the new generation's txn-role bundle
+        onto the best-fitness LIVE machine via the SHARED ranker
+        (cluster/recruitment.select_workers — the same code path the
+        multiprocess controller recruits by, so the tiers cannot
+        diverge): role-free machines beat storage/log machines,
+        lease-stale and protected machines are demoted, and ties break
+        by (dc, machine index) — never by container order. No live
+        machine ⇒ a named ``recruiting_transaction`` stall recorded in
+        the registry (status json shows it) and resumed by
+        restore_machine, mirroring the multiprocess parked recovery."""
         for m in self.machines:
             m.has_txn = False
-        target = next((m for m in self.machines if m.alive),
-                      self.machines[0])
+        candidates = [
+            WorkerInfo(
+                worker_id=m.name, process_class=m.process_class,
+                machine_id=m.name, dc=m.dc.index, index=m.index,
+                # Demotions within a fitness tier: stale lease worst,
+                # then coordinator (protected) machines, then tlog
+                # machines — co-locating the bundle with a tlog couples
+                # the generation to the one failure domain whose
+                # PERMANENT loss wedges the commit path (a dark log
+                # stalls every push until it returns).
+                penalty=(2 if not self.registry.is_live(m.name) else 0)
+                + (1 if m.protected else 0)
+                + (1 if (m.log_ids or m.remote_log_ids) else 0),
+            )
+            for m in self.machines if m.alive
+        ]
+        got = select_workers(candidates, "transaction", 1)
+        if not got:
+            # Parked: the old txn machine keeps the routing slot (dead —
+            # clients stall on their retry loops) until a machine comes
+            # back and restore_machine re-places.
+            self.registry.note_stall("transaction", detail="no live machine")
+            return
+        target = next(m for m in self.machines
+                      if m.name == got[0].worker_id)
         target.has_txn = True
         self.txn_machine = target
-        TraceEvent("SimTxnRolesPlaced").detail("Machine", target.name).log()
+        self.registry.note_resumed("transaction")
+        TraceEvent("SimTxnRolesPlaced").detail(
+            "Machine", target.name
+        ).detail("Class", target.process_class).log()
 
     def machine_of_tag(self, tag: int) -> SimMachine:
         return self.machines[tag % len(self.machines)]
@@ -377,6 +460,14 @@ class MachineTopology:
         for t in m.storage_tags:
             self.cluster.storages[t].start()
         self._set_logs_reachable(m, True)
+        # The sim analogue of a worker registering with the controller:
+        # a PARKED recruitment resumes the instant a machine comes back.
+        self.registry.register(
+            m.name, process_class=m.process_class, machine_id=m.name,
+            dc=m.dc.index, index=m.index, penalty=1 if m.protected else 0,
+        )
+        if self.registry.stalls:
+            self._place_txn_roles()
         TraceEvent("SimMachineRestored").detail("Machine", m.name).log()
 
     async def reboot_machine(self, m: SimMachine, outage: float = 0.2,

@@ -27,8 +27,9 @@ from ..core.trace import TraceEvent
 class MachineAttritionWorkload:
     def __init__(self, topology, interval: float = 0.8, kills: int = 2,
                  reboots: int = 1, swizzles: int = 1, dc_kills: int = 0,
-                 outage: float = 0.4, max_clog: float = 0.6,
-                 power_loss: bool = False, name: str = "machine-attrition"):
+                 permanent_kills: int = 0, outage: float = 0.4,
+                 max_clog: float = 0.6, power_loss: bool = False,
+                 name: str = "machine-attrition"):
         self.topo = topology
         self.cluster = topology.cluster
         self.interval = interval
@@ -38,12 +39,17 @@ class MachineAttritionWorkload:
         self.name = name
         # The action deck: shuffled off the loop PRNG at start, so the
         # seed owns the schedule's order as well as its timing.
+        # "permkill" is the PERMANENT machine loss (no restore until the
+        # closing heal): the shared-fate scenario the recruitment path
+        # must survive by re-placing the dead machine's roles elsewhere.
         self.deck = (["kill"] * kills + ["reboot"] * reboots
-                     + ["swizzle"] * swizzles + ["dc"] * dc_kills)
+                     + ["swizzle"] * swizzles + ["dc"] * dc_kills
+                     + ["permkill"] * permanent_kills)
         self.kills_done = 0
         self.reboots_done = 0
         self.swizzles_done = 0
         self.dc_kills_done = 0
+        self.permanent_kills_done = 0
         self.refused = 0
         self._task = None
 
@@ -83,6 +89,18 @@ class MachineAttritionWorkload:
                         self.outage * (0.3 + 0.7 * random.random01())
                     )
                     self.topo.restore_machine(m)
+            elif action == "permkill":
+                # PERMANENT loss: no restore — the cluster must
+                # re-recruit the dead machine's roles onto a survivor
+                # (quorum-safety-gated like every kill; _heal revives
+                # everything for the closing checks).
+                targets = self.topo.killable_machines()
+                if not targets:
+                    self.refused += 1
+                    continue
+                m = self._pick(random, targets)
+                if self.topo.kill_machine(m):
+                    self.permanent_kills_done += 1
             elif action == "reboot":
                 targets = self.topo.killable_machines()
                 if not targets:
@@ -132,7 +150,8 @@ class MachineAttritionWorkload:
         if any(m.kills > 0 and m.protected for m in self.topo.machines):
             return False
         acted = (self.kills_done + self.reboots_done
-                 + self.swizzles_done + self.dc_kills_done)
+                 + self.swizzles_done + self.dc_kills_done
+                 + self.permanent_kills_done)
         # At least one action must actually have landed (a nemesis whose
         # every move was refused tested nothing).
         return acted > 0 or not self.deck
@@ -143,6 +162,7 @@ class MachineAttritionWorkload:
             "reboots": self.reboots_done,
             "swizzles": self.swizzles_done,
             "dc_kills": self.dc_kills_done,
+            "permanent_kills": self.permanent_kills_done,
             "refused": self.refused,
             "protected_kill_attempts": self.topo.protected_kill_attempts,
         }

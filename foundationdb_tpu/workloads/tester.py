@@ -318,6 +318,7 @@ async def _run_workloads(cluster, db, spec) -> dict[str, Any]:
                 reboots=w.get("reboots", 1),
                 swizzles=w.get("swizzles", 1),
                 dc_kills=w.get("dc_kills", 0),
+                permanent_kills=w.get("permanent_kills", 0),
                 outage=w.get("outage", 0.4),
                 power_loss=w.get("power_loss", False),
                 name=f"machine-attrition-{rkey}",

@@ -57,6 +57,7 @@ def test_placement_and_protection():
             assert m.alive and m.kills == 0
         assert topo.protected_kill_attempts == len(protected)
         cluster.stop()
+    loop.shutdown()
 
 
 def test_shared_fate_kill_takes_cohosted_roles_and_recovers():
@@ -97,6 +98,7 @@ def test_shared_fate_kill_takes_cohosted_roles_and_recovers():
             cluster.stop()
 
         loop.run(main(), timeout_sim_seconds=600)
+    loop.shutdown()
     assert sink.count("SimMachineKilled") == 1
 
 
@@ -131,6 +133,7 @@ def test_power_loss_reboot_never_loses_acked_commits():
             cluster.stop()
 
         loop.run(main(), timeout_sim_seconds=600)
+    loop.shutdown()
 
 
 def test_dc_kill_respects_quorum_safety():
@@ -169,6 +172,7 @@ def test_dc_kill_respects_quorum_safety():
             cluster.stop()
 
         loop.run(main(), timeout_sim_seconds=600)
+    loop.shutdown()
 
 
 def _run_chaos(seed=None):

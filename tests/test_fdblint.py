@@ -601,6 +601,151 @@ def test_cli_json_and_exit_codes(tmp_path, capsys):
     assert rc == 0
 
 
+# ---------------------------------------------------------------------------
+# determinism pack: recruitment-path reachability (det-recruit-*)
+# ---------------------------------------------------------------------------
+
+_RECRUIT_CORE = {
+    "foundationdb_tpu/core.py": """
+        def sim_loop(seed):
+            return seed
+    """,
+    "foundationdb_tpu/cluster/recruitment.py": """
+        def select_workers(candidates, role, count=1):
+            ranked = sorted(candidates, key=lambda w: (w[0], w[1]))
+            return ranked[:count]
+    """,
+}
+
+
+def test_det_recruit_reach_good_wired(tmp_path):
+    fs = run_lint(tmp_path, {
+        **_RECRUIT_CORE,
+        "foundationdb_tpu/sim/runner.py": """
+            from foundationdb_tpu.core import sim_loop
+            from foundationdb_tpu.cluster.recruitment import select_workers
+
+            def run(seed):
+                loop = sim_loop(seed)
+                return select_workers([(0, "a"), (0, "b")], "transaction")
+        """,
+    })
+    assert rules_of(fs) == []
+
+
+def test_det_recruit_reach_bad_unwired(tmp_path):
+    fs = run_lint(tmp_path, {
+        **_RECRUIT_CORE,
+        "foundationdb_tpu/sim/runner.py": """
+            from foundationdb_tpu.core import sim_loop
+
+            def lowest_index_placement(machines):
+                return machines[0]
+
+            def run(seed):
+                loop = sim_loop(seed)
+                return lowest_index_placement(["m0", "m1"])
+        """,
+    })
+    assert rules_of(fs) == ["det-recruit-reach"]
+
+
+def test_det_recruit_reach_through_class_and_hook(tmp_path):
+    """The real wiring shape: sim_loop root -> class instantiation ->
+    method -> escaping recovery hook -> the shared ranker."""
+    fs = run_lint(tmp_path, {
+        **_RECRUIT_CORE,
+        "foundationdb_tpu/sim/topo.py": """
+            from foundationdb_tpu.cluster.recruitment import select_workers
+
+            class Topology:
+                def __init__(self, cluster):
+                    self._install_hook(cluster)
+
+                def _install_hook(self, cluster):
+                    def recover_and_place():
+                        self._place()
+                    cluster.recover = recover_and_place
+
+                def _place(self):
+                    return select_workers([(0, "a")], "transaction")
+        """,
+        "foundationdb_tpu/sim/runner.py": """
+            from foundationdb_tpu.core import sim_loop
+            from foundationdb_tpu.sim.topo import Topology
+
+            def run(seed, cluster):
+                loop = sim_loop(seed)
+                return Topology(cluster)
+        """,
+    })
+    assert rules_of(fs) == []
+
+
+def test_det_recruit_order_bad_picks(tmp_path):
+    fs = run_lint(tmp_path, {
+        "foundationdb_tpu/cluster/recruitment.py": """
+            def best(workers):
+                return max(workers.values())
+
+            def first(workers):
+                return next(iter(workers.values()))
+
+            def unkeyed(workers):
+                return sorted(workers.values())
+
+            def from_set(ids):
+                return min(set(ids))
+        """,
+    })
+    flagged = [f for f in fs if f.rule == "det-recruit-order"
+               and not f.suppressed]
+    assert len(flagged) == 4, [f.render() for f in fs]
+
+
+def test_det_recruit_order_good_total_key(tmp_path):
+    fs = run_lint(tmp_path, {
+        "foundationdb_tpu/cluster/recruitment.py": """
+            def ranked(workers):
+                return sorted(workers.values(),
+                              key=lambda w: (w.fitness, w.worker_id))
+
+            def by_key(workers):
+                return sorted(workers.items())
+        """,
+    })
+    assert rules_of(fs) == []
+
+
+def test_det_recruit_order_ignores_other_modules(tmp_path):
+    # The order rules are scoped to the recruitment path; elsewhere the
+    # package-wide det-set-order still governs sets.
+    fs = run_lint(tmp_path, {"foundationdb_tpu/other.py": """
+        def pick(workers):
+            return max(workers.values())
+    """})
+    assert rules_of(fs) == []
+
+
+def test_real_tree_recruitment_is_wired():
+    """The live assertion behind det-recruit-reach: the shipped sim tier
+    routes placement through the shared ranker."""
+    from tools.fdblint import rules_determinism as rd
+    from tools.fdblint.core import collect_files, load_file
+    from tools.fdblint.rules_jax import _Project
+
+    files = collect_files(["foundationdb_tpu"], REPO_ROOT)
+    ctxs = [c for c in (load_file(f, REPO_ROOT) for f in files)
+            if c is not None]
+    project = _Project(ctxs)
+    roots = rd._sim_loop_roots(project)
+    assert roots, "no sim_loop roots found in the package"
+    reachable = rd._reachable(project, roots)
+    assert any(fi.name == "select_workers"
+               and fi.ctx.path.endswith("cluster/recruitment.py")
+               for fi in reachable)
+
+
 def test_rules_registry_matches_readme():
     readme = open(os.path.join(REPO_ROOT, "tools", "fdblint",
                                "README.md")).read()

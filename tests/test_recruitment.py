@@ -1,0 +1,530 @@
+"""Self-healing recruitment (cluster/recruitment.py + sim/topology.py +
+cluster/multiprocess.py; ref: ClusterController.actor.cpp:1445 fitness
+ranking, worker.actor.cpp:481 worker registry + Initialize* dispatch).
+
+Covers the tentpole contracts:
+- fitness preference order and deterministic locality/index tie-breaks
+  of the SHARED ranker (one code path for sim and multiprocess);
+- worker registry heartbeat leases via the failure monitor, and
+  stall-then-resume: a parked recruitment wakes the instant the only
+  candidate registers late;
+- sim tier: re-recruitment after a PERMANENT machine kill — the txn
+  bundle moves to the best-fitness live machine and commits flow;
+- multiprocess tier (slow): machine-grouped shared-fate processes,
+  SIGKILL of the resolver host's machine, re-recruitment onto a
+  late-registering spare, all watched by an operator shell attached via
+  `cli.py --cluster-file` (stall appears and drains in `status json`).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from foundationdb_tpu.cluster.recruitment import (
+    Fitness,
+    RecruitmentStalled,
+    WorkerInfo,
+    WorkerRegistry,
+    fitness_for,
+    select_workers,
+)
+from foundationdb_tpu.core import loop_context
+from foundationdb_tpu.core.runtime import sim_loop
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# the shared ranker
+# ---------------------------------------------------------------------------
+
+def test_fitness_preference_order():
+    # Matching class beats stateless beats unset beats out-of-role
+    # stateful classes; tester/coordinator are never assigned.
+    assert fitness_for("resolver", "resolver") == Fitness.BEST
+    assert fitness_for("resolver1", "resolver") == Fitness.BEST
+    assert fitness_for("stateless", "resolver") == Fitness.GOOD
+    assert fitness_for("unset", "resolver") == Fitness.ACCEPTABLE
+    assert fitness_for("storage", "resolver") == Fitness.WORST_FIT
+    assert fitness_for("tester", "resolver") == Fitness.NEVER_ASSIGN
+    assert fitness_for("coordinator", "transaction") == Fitness.NEVER_ASSIGN
+    # The multiprocess txn class is the transaction bundle.
+    assert fitness_for("txn", "transaction") == Fitness.BEST
+    assert fitness_for("log2", "log") == Fitness.BEST
+
+
+def test_select_workers_prefers_fitness_then_locality():
+    ws = [
+        WorkerInfo("storage-host", process_class="storage", index=0),
+        WorkerInfo("idle-host", process_class="unset", index=5),
+        WorkerInfo("resolver-b", process_class="resolver", dc=1, index=0),
+        WorkerInfo("resolver-a", process_class="resolver", dc=0, index=3),
+        WorkerInfo("tester", process_class="test", index=0),
+    ]
+    got = select_workers(ws, "resolver", count=4)
+    # Best fitness first; among equals, (dc, index) break the tie; the
+    # NeverAssign tester is excluded outright.
+    assert [w.worker_id for w in got] == [
+        "resolver-a", "resolver-b", "idle-host", "storage-host"
+    ]
+    # max_fitness bounds desperation: only resolver-class hosts can
+    # actually serve the resolver endpoints on the multiprocess tier.
+    best_only = select_workers(ws, "resolver", count=4,
+                               max_fitness=Fitness.BEST)
+    assert [w.worker_id for w in best_only] == ["resolver-a", "resolver-b"]
+
+
+def test_select_workers_order_independent_of_input_order():
+    ws = [
+        WorkerInfo(f"w{i}", process_class=cls, dc=i % 2, index=i)
+        for i, cls in enumerate(
+            ["storage", "unset", "resolver", "unset", "storage", "resolver"]
+        )
+    ]
+    expect = [w.worker_id for w in select_workers(ws, "transaction", 6)]
+    for rot in range(1, len(ws)):
+        rotated = ws[rot:] + ws[:rot]
+        assert [w.worker_id
+                for w in select_workers(rotated, "transaction", 6)] == expect
+
+
+def test_penalty_demotes_within_fitness_only():
+    fresh = WorkerInfo("fresh", process_class="storage", penalty=0, index=9)
+    stale = WorkerInfo("stale", process_class="unset", penalty=2, index=0)
+    # Fitness dominates: a lease-stale unset machine still beats a fresh
+    # storage machine for the txn bundle.
+    got = select_workers([fresh, stale], "transaction", 2)
+    assert [w.worker_id for w in got] == ["stale", "fresh"]
+
+
+# ---------------------------------------------------------------------------
+# the worker registry (heartbeat lease + stall/resume)
+# ---------------------------------------------------------------------------
+
+def test_registry_lease_expiry_and_revival(sim):
+    reg = WorkerRegistry()
+    reg.start()
+
+    async def main():
+        loop = sim
+        reg.register("r0", process_class="resolver", address="a:1")
+        assert reg.is_live("r0")
+        assert reg.best_worker("resolver").worker_id == "r0"
+        # Silence past the lease: the worker leaves candidacy (and the
+        # embedded failure-detection sweep marks it failed).
+        await loop.delay(reg.lease_timeout * 2.5)
+        assert not reg.is_live("r0")
+        assert reg.best_worker("resolver") is None
+        assert reg.failure_server.is_failed("r0")
+        # One beat revives it.
+        reg.register("r0", process_class="resolver", address="a:1")
+        assert reg.is_live("r0")
+        assert not reg.failure_server.is_failed("r0")
+
+    sim.run(main(), timeout_sim_seconds=60)
+    reg.stop()
+
+
+def test_registry_stall_then_resume_on_late_registration(sim):
+    """The only candidate registers LATE: the stalled recruitment parks
+    on the registration event and resumes the instant it lands."""
+    from foundationdb_tpu.core.runtime import spawn
+
+    reg = WorkerRegistry()
+    events = []
+
+    async def recruiter():
+        loop = sim
+        while True:
+            try:
+                got = reg.recruit("resolver", 1, max_fitness=Fitness.BEST)
+                events.append(("recruited", got[0].worker_id, loop.now()))
+                return
+            except RecruitmentStalled as e:
+                assert e.state_name == "recruiting_resolver"
+                events.append(("stalled", loop.now()))
+                await reg.wait_for_worker(timeout_s=30.0)
+
+    async def main():
+        loop = sim
+        t = spawn(recruiter(), name="recruiter")
+        await loop.delay(5.0)
+        assert reg.stalls and "resolver" in reg.stalls
+        assert events and events[0][0] == "stalled"
+        registered_at = loop.now()
+        reg.register("late-resolver", process_class="resolver",
+                     address="b:2")
+        await t.done
+        assert events[-1][0] == "recruited"
+        assert events[-1][1] == "late-resolver"
+        # Resumed promptly on the registration bump, not a retry timer:
+        # well inside the 30s park window the recruiter asked for.
+        assert events[-1][2] - registered_at < 1.0
+        assert "resolver" not in reg.stalls
+        st = reg.status()
+        assert st["stalls_total"] == 1 and st["recruits_total"] == 1
+
+    sim.run(main(), timeout_sim_seconds=120)
+
+
+# ---------------------------------------------------------------------------
+# sim tier: ranked placement + permanent-kill re-recruitment
+# ---------------------------------------------------------------------------
+
+def _topo_cluster(**kw):
+    from foundationdb_tpu.cluster.recovery import RecoverableShardedCluster
+    from foundationdb_tpu.sim.topology import MachineTopology
+
+    topo_kw = kw.pop("topo", {"n_dcs": 1, "machines_per_dc": 4})
+    base = dict(n_storage=4, n_logs=2, replication="double",
+                shard_boundaries=[b"m"], topology=topo_kw)
+    base.update(kw)
+    cluster = RecoverableShardedCluster(**base).start()
+    topo = MachineTopology(cluster, **topo_kw)
+    cluster.sim_topology = topo
+    return cluster, topo
+
+
+def test_sim_rerecruits_txn_roles_after_permanent_kill():
+    loop = sim_loop(seed=21)
+    with loop_context(loop):
+        # 6 machines, storage everywhere, logs on m0/m1, coordinators
+        # protecting m3..m5: the ranker places the txn bundle on m2 —
+        # the first unprotected machine OUTSIDE the tlog failure domains
+        # (the self-healing placement: its permanent loss must not
+        # wedge the commit path).
+        cluster, topo = _topo_cluster(
+            n_storage=6, topo={"n_dcs": 1, "machines_per_dc": 6}
+        )
+        db = topo.database()
+
+        async def main():
+            for i in range(8):
+                await db.set(b"p%d" % i, b"v%d" % i)
+            m2 = topo.machines[2]
+            assert m2.has_txn, repr(topo.machines)
+            assert not m2.log_ids and not m2.protected
+            rec_before = cluster.recoveries_done
+            # PERMANENT kill: no restore — the recruited topology must
+            # carry the txn bundle to a surviving machine forever.
+            assert topo.kill_machine(m2)
+            cluster.start_controller("perm-kill-test")
+            deadline = loop.now() + 30.0
+            while cluster.recoveries_done == rec_before \
+                    and loop.now() < deadline:
+                await loop.delay(0.1)
+            assert cluster.recoveries_done > rec_before
+            assert topo.txn_machine is not m2 and topo.txn_machine.alive
+            # The ranker re-ranked the LIVE machines: every survivor is
+            # log-hosting or protected (penalty 1), so lowest (dc,
+            # index) among them — m0 — wins deterministically.
+            assert topo.txn_machine is topo.machines[0]
+            # Commits flow on the re-recruited generation; acked data
+            # survived (m2's storage replicas have live teammates).
+            for i in range(8):
+                assert await db.get(b"p%d" % i) == b"v%d" % i
+            await db.set(b"after", b"rerecruited")
+            assert await db.get(b"after") == b"rerecruited"
+            cluster.stop()
+
+        loop.run(main(), timeout_sim_seconds=600)
+    loop.shutdown()
+
+
+def test_sim_stall_and_resume_visible_in_status():
+    from foundationdb_tpu.cluster.status import cluster_status
+    from foundationdb_tpu.core.trace import TraceSink, set_global_sink
+
+    sink = TraceSink()
+    set_global_sink(sink)
+    loop = sim_loop(seed=23)
+    with loop_context(loop):
+        cluster, topo = _topo_cluster()
+
+        async def main():
+            # Force the no-candidate shape directly (the nemesis can
+            # never legally produce it: can_kill always leaves a live
+            # machine): every machine dark, then a placement pass.
+            for m in topo.machines:
+                m.alive = False
+            topo._place_txn_roles()
+            assert "transaction" in topo.registry.stalls
+            st = cluster_status(cluster)
+            assert st["cluster"]["recovery_state"]["name"] \
+                == "recruiting_transaction"
+            assert st["cluster"]["recruitment"]["stalls"]
+            # A machine coming back IS the registration event: placement
+            # resumes instantly and status drains.
+            m = topo.machines[2]
+            m.alive = False  # restore_machine requires a dead machine
+            topo.restore_machine(m)
+            assert "transaction" not in topo.registry.stalls
+            assert topo.txn_machine is m and m.has_txn
+            st = cluster_status(cluster)
+            assert st["cluster"]["recovery_state"]["name"] \
+                in ("fully_recovered", "recovering")
+            assert not st["cluster"]["recruitment"]["stalls"]
+            cluster.stop()
+
+        loop.run(main(), timeout_sim_seconds=60)
+    loop.shutdown()
+    assert sink.count("RecruitmentStalled") >= 1
+    assert sink.count("RecruitmentResumed") >= 1
+
+
+def test_chaos_recruitment_spec_green_and_deterministic():
+    from foundationdb_tpu.workloads.tester import run_spec
+
+    with open(os.path.join(ROOT, "specs", "chaos_recruitment.json")) as f:
+        spec = json.load(f)
+    a = run_spec(spec)
+    assert a["ok"], a
+    assert a["sev_errors"] == 0
+    assert a["MachineAttrition"]["metrics"]["permanent_kills"] >= 1
+    b = run_spec(spec)
+    assert b["fingerprint"] == a["fingerprint"], \
+        "same seed must replay the same kill/recruitment schedule"
+
+
+# ---------------------------------------------------------------------------
+# multiprocess tier (slow): machines, shared fate, cli attach
+# ---------------------------------------------------------------------------
+
+def _free_ports(n):
+    import socket
+
+    socks = [socket.socket() for _ in range(n)]
+    try:
+        for s in socks:
+            s.bind(("127.0.0.1", 0))
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
+def _write_spec(tmp_path, classes, machines=None, spec_extra=None):
+    from foundationdb_tpu.cluster.multiprocess import write_cluster_file
+
+    cf = str(tmp_path / "cluster.json")
+    ports = _free_ports(len(classes))
+    spec = {
+        "n_storage": 4, "n_logs": 2, "replication": "double",
+        "shard_boundaries": ["m"], "engine": "memory", "seed": 1,
+        **(spec_extra or {}),
+        "ports": dict(zip(classes, ports)),
+    }
+    if machines:
+        spec["machines"] = machines
+    write_cluster_file(cf, {"spec": spec})
+    return cf
+
+
+def _spawn_class(cf, tmp_path, cls):
+    return subprocess.Popen(
+        [sys.executable, "-m", "foundationdb_tpu.server", "-r", "fdbd",
+         "-c", cls, "-C", cf, "-d", str(tmp_path / "data" / cls)],
+        cwd=ROOT, stderr=subprocess.PIPE, text=True,
+        start_new_session=True,
+    )
+
+
+def _spawn_machine(cf, tmp_path, machine_id):
+    # The launcher is its own session/process-group leader; every role
+    # host it spawns inherits the group — killpg IS the machine dying.
+    return subprocess.Popen(
+        [sys.executable, "-m", "foundationdb_tpu.server", "-r", "fdbd",
+         "-m", machine_id, "-C", cf,
+         "-d", str(tmp_path / "mach" / machine_id)],
+        cwd=ROOT, stderr=subprocess.PIPE, text=True,
+        start_new_session=True,
+    )
+
+
+def _teardown(procs):
+    for p in procs:
+        try:
+            os.killpg(os.getpgid(p.pid), signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            pass
+    for p in procs:
+        try:
+            p.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            p.wait(timeout=10)
+
+
+def _wait_keys(cf, keys, procs, deadline_s=90):
+    from foundationdb_tpu.cluster.multiprocess import read_cluster_file
+
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        info = read_cluster_file(cf) or {}
+        if all(k in info for k in keys):
+            return info
+        for p in procs:
+            if p.poll() is not None:
+                raise RuntimeError(
+                    f"host died rc={p.returncode}: "
+                    f"{p.stderr.read()[-2000:]}"
+                )
+        time.sleep(0.1)
+    raise RuntimeError(f"cluster keys {keys} never appeared")
+
+
+@pytest.mark.slow
+def test_cli_cluster_file_attach_roundtrip(tmp_path):
+    """`python -m foundationdb_tpu.cli --cluster-file <f>` attaches the
+    operator shell to a DEPLOYED cluster: status json + recruitment come
+    from the controller over the control RPCs, data verbs ride the
+    normal client connection."""
+    from foundationdb_tpu.cli import Cli
+
+    classes = ("log", "storage", "txn")
+    cf = _write_spec(tmp_path, classes)
+    procs = [_spawn_class(cf, tmp_path, c) for c in classes]
+    try:
+        _wait_keys(cf, classes + ("controller",), procs)
+        cli = Cli(cluster_file=cf)
+        try:
+            # Every host heartbeats into the registry (bounded poll: the
+            # attach can beat a host's first registration by a beat).
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                st = json.loads(cli.execute("status json"))
+                workers = st["cluster"]["recruitment"]["workers"]
+                classes_seen = {w["class"] for w in workers}
+                if {"log", "storage", "txn"} <= classes_seen:
+                    break
+                time.sleep(0.3)
+            assert {"log", "storage", "txn"} <= classes_seen, workers
+            assert st["cluster"]["recovery_state"]["name"] \
+                == "fully_recovered"
+            assert all(w["live"] for w in workers)
+            assert not st["cluster"]["recruitment"]["stalls"]
+            # Data verbs ride the client connection end to end.
+            assert cli.execute("writemode on") == "writemode on"
+            assert cli.execute("set opkey opval") == "Committed"
+            assert "opval" in cli.execute("get opkey")
+            # The recruitment verb renders the registry.
+            rec = cli.execute("recruitment")
+            assert "No recruitment stalls." in rec
+            assert "class=txn" in rec
+            # Management verbs ride the \xff keyspace over the wire.
+            assert "Excluded servers:" in cli.execute("exclude")
+            # Summary status renders from the controller document too.
+            assert "Recovery state: fully_recovered" \
+                in cli.execute("status")
+        finally:
+            cli.close()
+    finally:
+        _teardown(procs)
+
+
+@pytest.mark.slow
+def test_resolver_machine_sigkill_rerecruit_with_attached_shell(tmp_path):
+    """THE acceptance scenario: machine-grouped processes (shared-fate
+    process groups), the resolver host's machine SIGKILLed permanently,
+    the recovery parking in recruiting_resolver — watched appearing and
+    DRAINING through an attached operator shell — and commits flowing
+    again once a late spare registers and is recruited."""
+    from foundationdb_tpu.cli import Cli
+    from foundationdb_tpu.cluster.multiprocess import resolver_host_classes
+
+    res0, res1 = resolver_host_classes(2)
+    classes = ("log", "storage", "txn", res0, res1)
+    machines = {
+        "m0": ["log", "storage", "txn"],
+        "m1": [res0],
+        "m2": [res1],
+    }
+    cf = _write_spec(
+        tmp_path, classes, machines=machines,
+        spec_extra={"n_resolvers": 1},
+    )
+    m0 = _spawn_machine(cf, tmp_path, "m0")
+    m1 = _spawn_machine(cf, tmp_path, "m1")
+    procs = [m0, m1]
+    try:
+        _wait_keys(cf, ("log", "storage", "txn", "resolver0"), procs,
+                   deadline_s=120)
+        cli = Cli(cluster_file=cf)
+        try:
+            # Healthy: resolver0 recruited, writes flow.
+            st = json.loads(cli.execute("status json"))
+            assert st["cluster"]["recovery_state"]["name"] \
+                == "fully_recovered"
+            assert st["cluster"]["recruitment"]["recruited"][
+                "resolver"].startswith("resolver0@")
+            cli.execute("writemode on")
+            assert cli.execute("set before kill") == "Committed"
+
+            # The shared-fate kill script the machine launcher wrote:
+            # kill -9 of m1's process GROUP — launcher + resolver host
+            # die at one instant, permanently.
+            kill_sh = tmp_path / "mach" / "m1" / "kill.sh"
+            assert kill_sh.exists()
+            os.killpg(os.getpgid(m1.pid), signal.SIGKILL)
+            m1.wait(timeout=20)
+
+            # The operator WATCHES the stall appear: controller detects
+            # the lapsed lease, re-recovers, and parks recruiting the
+            # resolver (no candidate exists).
+            deadline = time.time() + 90
+            stalled = False
+            name = None
+            while time.time() < deadline:
+                st = json.loads(cli.execute("status json"))
+                name = st["cluster"]["recovery_state"]["name"]
+                if name == "recruiting_resolver" \
+                        and "resolver" in st["cluster"]["recruitment"][
+                            "stalls"]:
+                    stalled = True
+                    break
+                time.sleep(0.5)
+            assert stalled, f"stall never surfaced (last state {name})"
+            rec = cli.execute("recruitment")
+            assert "STALL recruiting_resolver" in rec
+
+            # The late spare machine registers; the stall DRAINS the
+            # moment it is recruited.
+            m2 = _spawn_machine(cf, tmp_path, "m2")
+            procs.append(m2)
+            deadline = time.time() + 120
+            drained = False
+            while time.time() < deadline:
+                st = json.loads(cli.execute("status json"))
+                if st["cluster"]["recovery_state"]["name"] \
+                        == "fully_recovered" \
+                        and not st["cluster"]["recruitment"]["stalls"]:
+                    drained = True
+                    break
+                time.sleep(0.5)
+            assert drained, "stall never drained after the spare joined"
+            assert st["cluster"]["recruitment"]["recruited"][
+                "resolver"].startswith("resolver1@")
+
+            # Commits flow again through the re-recruited fleet, and
+            # pre-kill data survived.
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                out = cli.execute("set after rerecruit")
+                if out == "Committed":
+                    break
+                time.sleep(0.5)
+            assert out == "Committed", out
+            assert "kill" in cli.execute("get before")
+            assert "rerecruit" in cli.execute("get after")
+        finally:
+            cli.close()
+    finally:
+        _teardown(procs)

@@ -40,6 +40,8 @@ RULES: dict[str, str] = {
     "det-sleep": "blocking time.sleep on a sim-reachable path (use runtime delay())",
     "det-random": "unseeded/global randomness (random.*, os.urandom, uuid4, np.random.*) on a sim-reachable path",
     "det-set-order": "set iterated into an ordered output (iteration order is hash-seed dependent)",
+    "det-recruit-reach": "recruitment ranker (cluster/recruitment.py select_workers) unreachable from sim_loop roots — sim placement diverged from the shared code path",
+    "det-recruit-order": "recruitment-path candidate selection depends on dict/set iteration order (min/max/unkeyed sorted/next(iter) over value views; rank with a total sorted key)",
     "async-blocking": "blocking primitive (time.sleep, sync open(), subprocess) inside async def",
     "async-unawaited": "coroutine created but neither awaited nor handed to spawn/Task",
     "async-await-in-finally": "await inside finally without cancellation shielding",
@@ -261,6 +263,7 @@ def lint_paths(paths: Iterable[str], root: Optional[str] = None,
             findings.extend(pack.check(ctx))
     findings.extend(rules_knobs.check_project(ctxs))
     findings.extend(rules_jax.check_project(ctxs))
+    findings.extend(rules_determinism.check_project(ctxs))
 
     by_path = {c.path: c for c in ctxs}
     if baseline is None:

@@ -237,3 +237,213 @@ def _check_set_order(ctx: FileCtx) -> list[Finding]:
         findings.extend(v.findings)
         stack.extend(v.children)
     return findings
+
+
+# -- det-recruit-reach / det-recruit-order ------------------------------
+#
+# The recruitment/ranking path (cluster/recruitment.py select_workers)
+# is checked by CALL-GRAPH REACHABILITY from sim_loop roots instead of
+# package-scope pragmas (the ROADMAP's lint-reachability direction, like
+# the JAX pack's jit-root taint): the sim tier's placement must actually
+# route through the shared ranker (det-recruit-reach fires when a
+# refactor unwires it — the tiers could then silently diverge), and on
+# that path candidate selection must rank with a TOTAL explicit key —
+# ties break by locality/index, never by dict or set iteration order
+# (det-recruit-order).
+
+_RECRUIT_SUFFIX = "cluster/recruitment.py"
+_RECRUIT_ANCHOR = "select_workers"
+
+
+def check_project(ctxs: list[FileCtx]) -> list[Finding]:
+    recruit_ctxs = [c for c in ctxs if c.path.endswith(_RECRUIT_SUFFIX)]
+    if not recruit_ctxs:
+        return []
+    out: list[Finding] = []
+    for ctx in recruit_ctxs:
+        out.extend(_check_recruit_order(ctx))
+    out.extend(_check_recruit_reach(ctxs, recruit_ctxs))
+    return out
+
+
+def _anchor_def(ctx: FileCtx) -> Optional[ast.AST]:
+    for node in ctx.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == _RECRUIT_ANCHOR:
+            return node
+    return None
+
+
+def _check_recruit_reach(ctxs, recruit_ctxs) -> list[Finding]:
+    from .rules_jax import _Project
+
+    anchors = [(c, _anchor_def(c)) for c in recruit_ctxs]
+    anchors = [(c, n) for c, n in anchors if n is not None]
+    if not anchors:
+        return []  # no ranker defined: nothing to wire
+    project = _Project(ctxs)
+    roots = _sim_loop_roots(project)
+    if not roots:
+        # No simulator entry in the linted set (single-file invocations,
+        # fixtures without a harness): reachability is unjudgeable.
+        return []
+    reachable = _reachable(project, roots)
+    for ctx, node in anchors:
+        hit = any(fi.name == _RECRUIT_ANCHOR
+                  and fi.ctx.path.endswith(_RECRUIT_SUFFIX)
+                  for fi in reachable)
+        if not hit:
+            return [Finding(
+                ctx.path, node.lineno, "det-recruit-reach",
+                f"{_RECRUIT_ANCHOR}() is not reachable from any sim_loop "
+                "root: the sim tier's placement no longer routes through "
+                "the shared recruitment ranker (tiers can diverge)",
+                end_line=node.lineno)]
+    return []
+
+
+def _sim_loop_roots(project) -> list:
+    """Functions that call core.sim_loop — the simulator entry points the
+    reachability walk starts from."""
+    roots = []
+    for ctx in project.ctxs:
+        idx = project.indexers[ctx.path]
+        for fi in idx.funcs:
+            for call in ast.walk(fi.node):
+                if isinstance(call, ast.Call):
+                    r = ctx.resolve(call.func)
+                    if r and (r == "sim_loop"
+                              or r.endswith(".sim_loop")):
+                        roots.append(fi)
+                        break
+    # de-dup while keeping deterministic order
+    seen, out = set(), []
+    for fi in roots:
+        if id(fi) not in seen:
+            seen.add(id(fi))
+            out.append(fi)
+    return out
+
+
+def _class_index(project) -> dict:
+    """(module, class name) -> method FuncInfos, so instantiation edges
+    conservatively reach every method (recovery hooks, served handlers
+    and other dynamically-invoked methods stay in the closure)."""
+    index: dict = {}
+    for ctx in project.ctxs:
+        idx = project.indexers[ctx.path]
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            methods = [idx.by_node[n] for n in ast.walk(node)
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))
+                       and n in idx.by_node]
+            index[(ctx.module, node.name)] = methods
+    return index
+
+
+def _reachable(project, roots) -> set:
+    classes = _class_index(project)
+
+    def class_targets(ctx, call: ast.Call) -> list:
+        fn = call.func
+        name = None
+        if isinstance(fn, ast.Name):
+            name = fn.id
+        elif isinstance(fn, ast.Attribute):
+            name = fn.attr
+        if name is None:
+            return []
+        hit = classes.get((ctx.module, name))
+        if hit is not None:
+            return hit
+        imp = project.imports[ctx.path].get(name)
+        if imp is not None:
+            return classes.get((imp[0], imp[1]), [])
+        return []
+
+    seen = set(roots)
+    work = list(roots)
+    while work:
+        fi = work.pop()
+        # fi.node's walk covers nested defs too: calls made inside
+        # escaping closures (recovery hooks) are attributed to fi, which
+        # is the conservative direction for reachability.
+        for call in ast.walk(fi.node):
+            if not isinstance(call, ast.Call):
+                continue
+            tgt = project.resolve_func(fi.ctx, fi, call.func)
+            for t in ([tgt] if tgt is not None else []):
+                if t not in seen:
+                    seen.add(t)
+                    work.append(t)
+            for t in class_targets(fi.ctx, call):
+                if t not in seen:
+                    seen.add(t)
+                    work.append(t)
+    return seen
+
+
+_DICT_VALUE_VIEWS = {"values"}
+
+
+def _is_value_view(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _DICT_VALUE_VIEWS
+            and not node.args)
+
+
+def _is_setish(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id in ("set", "frozenset"))
+
+
+def _check_recruit_order(ctx: FileCtx) -> list[Finding]:
+    """Order-safety ON the recruitment path: picking a winner out of a
+    dict's values or a set by container order is exactly how placement
+    becomes a function of registration history instead of registry
+    content. min/max resolve ties by iteration order even WITH a key, so
+    they are banned over value views/sets outright; sorted() needs an
+    explicit key (make it total — end it with a unique id); next(iter())
+    is a first-by-container-order pick."""
+    out: list[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not isinstance(fn, ast.Name) or not node.args:
+            continue
+        arg = node.args[0]
+        loc = dict(end_line=getattr(node, "end_lineno", node.lineno)
+                   or node.lineno)
+        if fn.id in ("min", "max") and (_is_value_view(arg)
+                                        or _is_setish(arg)):
+            out.append(Finding(
+                ctx.path, node.lineno, "det-recruit-order",
+                f"{fn.id}() over a dict value view/set on the recruitment "
+                "path resolves ties by container order; rank with "
+                "sorted(..., key=) ending in a unique id", **loc))
+        elif fn.id == "sorted" and (_is_value_view(arg)
+                                    or _is_setish(arg)) \
+                and not any(kw.arg == "key" for kw in node.keywords):
+            out.append(Finding(
+                ctx.path, node.lineno, "det-recruit-order",
+                "sorted() without an explicit key over a dict value "
+                "view/set on the recruitment path; supply a TOTAL key "
+                "(end it with a unique id)", **loc))
+        elif fn.id == "next" and isinstance(arg, ast.Call) \
+                and isinstance(arg.func, ast.Name) \
+                and arg.func.id == "iter" and arg.args \
+                and (_is_value_view(arg.args[0])
+                     or _is_setish(arg.args[0])):
+            out.append(Finding(
+                ctx.path, node.lineno, "det-recruit-order",
+                "next(iter(...)) over a dict value view/set on the "
+                "recruitment path picks by container order; rank with "
+                "sorted(..., key=)", **loc))
+    return out

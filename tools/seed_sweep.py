@@ -74,6 +74,42 @@ def regions_spec(seed: int) -> dict:
     return spec
 
 
+def recruitment_spec(seed: int) -> dict:
+    """Per-seed variation of the recruitment chaos base
+    (specs/chaos_recruitment.json: PERMANENT machine kills under the
+    fitness-ranked re-placement path): randomized recruitment knobs —
+    heartbeat cadence, lease horizon, stall-retry delay — plus the
+    kill/permanent-kill mix. Deterministic per seed; the printed spec IS
+    the repro."""
+    import random
+
+    base_path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "specs", "chaos_recruitment.json")
+    with open(base_path) as f:
+        spec = json.load(f)
+    rng = random.Random(seed)
+    spec["seed"] = seed
+    knobs = spec.setdefault("knobs", {})
+    if rng.random() < 0.7:
+        knobs["server:WORKER_HEARTBEAT_INTERVAL"] = round(
+            0.1 + rng.random() * 0.9, 4
+        )
+    if rng.random() < 0.7:
+        knobs["server:WORKER_LEASE_TIMEOUT"] = round(
+            0.5 + rng.random() * 3.5, 4
+        )
+    if rng.random() < 0.7:
+        knobs["server:RECRUITMENT_STALL_RETRY_DELAY"] = round(
+            0.05 + rng.random() * 0.95, 4
+        )
+    for w in spec["workloads"]:
+        if w["name"] == "MachineAttrition":
+            w["permanent_kills"] = rng.randint(1, 3)
+            w["kills"] = rng.randint(0, 2)
+            w["reboots"] = rng.randint(0, 2)
+    return spec
+
+
 def parse_seeds(spec: str) -> list[int]:
     if ":" in spec:
         lo, hi = spec.split(":", 1)
@@ -90,10 +126,13 @@ def main() -> int:
     ap.add_argument("--randomized", action="store_true",
                     help="derive each seed's spec via sim.config."
                          "generate_config instead of --spec")
-    ap.add_argument("--preset", choices=["regions"],
+    ap.add_argument("--preset", choices=["regions", "recruitment"],
                     help="named sweep preset: 'regions' = two-DC log "
                          "shipping chaos (DC kills + attrition) with "
-                         "per-seed randomized replication modes")
+                         "per-seed randomized replication modes; "
+                         "'recruitment' = PERMANENT role-host machine "
+                         "kills under fitness-ranked re-placement with "
+                         "randomized heartbeat/lease/stall-retry knobs")
     ap.add_argument("--seeds", default="20",
                     help='"lo:hi", "a,b,c", or a count N (default 20)')
     ap.add_argument("--check-determinism", action="store_true",
@@ -124,6 +163,8 @@ def main() -> int:
             spec = generate_config(seed)
         elif args.preset == "regions":
             spec = regions_spec(seed)
+        elif args.preset == "recruitment":
+            spec = recruitment_spec(seed)
         else:
             spec = {**base, "seed": seed}
         try:
