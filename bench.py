@@ -1420,6 +1420,14 @@ def measure_commit_plane(seed: int) -> dict:
                     if len(cmt) else None,
                     "server_status": _commit_plane_status(cf),
                 }
+                # Flight-recorder latency bands (knob-configured edges)
+                # alongside the stage breakdown: the cumulative GRV/commit
+                # histograms the txn host's proxy accumulated this stage.
+                leg["latency_bands"] = (
+                    (leg["server_status"].get("proxy") or {})
+                    .get("commit_pipeline", {})
+                    .get("latency_bands")
+                )
                 legs.append(leg)
                 log(f"[commit-plane] {leg['clients']} clients: "
                     f"{leg['commits_per_sec']:.0f} commits/s  "

@@ -23,6 +23,13 @@ Commands (the fdbcli core surface):
                                   attached: served by the controller)
     recruitment [json]            worker registry + recruitment stalls
                                   (attached: the controller's registry)
+    trace <debug-id>              flight recorder: fetch the sampled
+                                  transaction's micro events from every
+                                  process and print the stitched timeline
+                                  with per-hop deltas (follows its commit
+                                  batch's attach edge)
+    events [--type T] [--severity N] [--last N]
+                                  tail the fleet's recent trace events
     configure <k=v> ...           set replicated configuration (\xff/conf)
     configuration                 show replicated configuration
     exclude [tag ...]             exclude storage servers (no args: list);
@@ -133,6 +140,127 @@ class Cli:
 
         return self._run(rpc())
 
+    # -- flight recorder (trace / events verbs) --
+    def _trace_addresses(self) -> dict:
+        """role -> address of every process of the attached deployment
+        (cluster-file keys holding host:port strings; the controller
+        alias duplicates the txn host and is dropped)."""
+        from .cluster.multiprocess import read_cluster_file
+
+        info = read_cluster_file(self.cluster_file) or {}
+        out = {}
+        seen = set()
+        for k in sorted(info):
+            v = info[k]
+            if k in ("spec", "controller") or not isinstance(v, str) \
+                    or ":" not in v:
+                continue
+            if v in seen:
+                continue
+            seen.add(v)
+            out[k] = v
+        return out
+
+    def fetch_trace_events(self, **kw) -> list[tuple[str, dict]]:
+        """(process, event) pairs matching a TraceEventsRequest filter,
+        pulled from every process of the deployment (attached) or from
+        the embedded cluster's global sink. Unreachable processes are
+        skipped — a dead host must not hide the survivors' evidence."""
+        if self._ctrl is None:
+            from .core.trace import global_sink
+
+            req_dbg = kw.get("debug_id")
+            req_type = kw.get("event_type")
+            req_sev = kw.get("min_severity", 0)
+            out = []
+            for e in global_sink().events:
+                if req_dbg is not None and (
+                    e.get("DebugID") != req_dbg and e.get("To") != req_dbg
+                ):
+                    continue
+                if req_type is not None and e.get("Type") != req_type:
+                    continue
+                if req_sev and e.get("Severity", 0) < req_sev:
+                    continue
+                out.append(("local", e))
+            if kw.get("last"):
+                out = out[-kw["last"]:]
+            return out
+        from .cluster import multiprocess as mp
+        from .core.actors import timeout
+
+        out = []
+        for role, addr in self._trace_addresses().items():
+            req = mp.TraceEventsRequest(**kw)
+            stream = self._transport.remote_stream(addr, mp.WLTOKEN_TRACE)
+
+            async def rpc(req=req, stream=stream):
+                stream.send(req)
+                return await timeout(req.reply.future, 10, None)
+
+            reply = self._run(rpc(), timeout=15)
+            if reply is None:
+                continue
+            proc = reply.get("process") or role
+            for e in reply.get("events", []):
+                out.append((proc, e))
+        return out
+
+    def trace_timeline(self, debug_id: str) -> list[tuple[str, dict]]:
+        """The stitched flight-recorder timeline of one debug ID: its own
+        events, plus (following TransactionAttach edges both ways) the
+        commit batches it joined — sorted by event time."""
+        events = self.fetch_trace_events(debug_id=debug_id)
+        related = {
+            e.get("To") for _, e in events
+            if e.get("Type") == "TransactionAttach"
+            and e.get("DebugID") == debug_id and e.get("To")
+        }
+        related |= {
+            e.get("DebugID") for _, e in events
+            if e.get("Type") == "TransactionAttach"
+            and e.get("To") == debug_id and e.get("DebugID")
+        }
+        related.discard(debug_id)
+        for rid in sorted(related):
+            events.extend(self.fetch_trace_events(debug_id=rid))
+        seen = set()
+        uniq = []
+        for proc, e in events:
+            key = (proc, json.dumps(e, sort_keys=True, default=str))
+            if key not in seen:
+                seen.add(key)
+                uniq.append((proc, e))
+        uniq.sort(key=lambda pe: (pe[1].get("Time") or 0.0))
+        return uniq
+
+    @staticmethod
+    def _render_event_line(t0, prev, proc: str, e: dict) -> str:
+        t = e.get("Time") or 0.0
+        hop = e.get("Location") or e.get("Type")
+        extras = " ".join(
+            f"{k}={e[k]}" for k in sorted(e)
+            if k not in ("Time", "Type", "Severity", "Location", "DebugID")
+        )
+        return (f"  {t - t0:10.6f}s  (+{(t - prev) * 1e3:9.3f} ms)  "
+                f"[{proc:<24}] {hop:<22} {extras}")
+
+    def _render_timeline(self, debug_id: str) -> str:
+        timeline = self.trace_timeline(debug_id)
+        if not timeline:
+            return (f"no flight-recorder events for {debug_id} — was the "
+                    "transaction sampled (client:COMMIT_SAMPLE_RATE) and "
+                    "recent enough for the in-memory windows?")
+        t0 = timeline[0][1].get("Time") or 0.0
+        lines = [f"flight recorder: {debug_id} "
+                 f"({len(timeline)} events, "
+                 f"{len({p for p, _ in timeline})} processes)"]
+        prev = t0
+        for proc, e in timeline:
+            lines.append(self._render_event_line(t0, prev, proc, e))
+            prev = e.get("Time") or prev
+        return "\n".join(lines)
+
     def execute(self, line: str) -> str:
         parts = line.strip().split()
         if not parts:
@@ -241,6 +369,35 @@ class Cli:
             else:
                 lines.append("No recruitment stalls.")
             return "\n".join(lines)
+        if cmd == "trace":
+            if len(args) != 1:
+                return "usage: trace <debug-id>"
+            return self._render_timeline(args[0])
+        if cmd == "events":
+            kw: dict = {}
+            last = 20
+            it = iter(args)
+            for a in it:
+                if a == "--type":
+                    kw["event_type"] = next(it)
+                elif a == "--severity":
+                    kw["min_severity"] = int(next(it))
+                elif a == "--last":
+                    last = int(next(it))
+                else:
+                    return "usage: events [--type T] [--severity N] [--last N]"
+            evs = self.fetch_trace_events(**kw)
+            evs.sort(key=lambda pe: (pe[1].get("Time") or 0.0))
+            evs = evs[-last:]
+            if not evs:
+                return "no matching events"
+            t0 = evs[0][1].get("Time") or 0.0
+            lines = []
+            prev = t0
+            for proc, e in evs:
+                lines.append(self._render_event_line(t0, prev, proc, e))
+                prev = e.get("Time") or prev
+            return "\n".join(lines)
         if cmd == "configure":
             self._need_write_mode()
             from .cluster import management
@@ -340,8 +497,22 @@ def main(argv=None) -> None:
                     help="attach to a DEPLOYED multiprocess cluster via "
                          "its shared cluster file instead of starting an "
                          "embedded one")
+    ap.add_argument("command", nargs="*",
+                    help="one-shot: run a single shell command (e.g. "
+                         "`trace <debug-id>`, `events --severity 30`, "
+                         "`status json`) and exit")
     args = ap.parse_args(argv)
     cli = Cli(cluster_file=args.cluster_file)
+    if args.command:
+        # One-shot verb: scriptable operator path (the acceptance tests'
+        # `cli.py trace <debug-id>` invocation shape).
+        try:
+            out = cli.execute(" ".join(args.command))
+            if out:
+                print(out)
+        finally:
+            cli.close()
+        return
     if args.cluster_file:
         print(f"fdbtpu-cli: attached to {args.cluster_file} (type help)")
     else:

@@ -81,9 +81,14 @@ class ClusterConnection:
                 CLIENT_KNOBS.DEFAULT_MAX_BACKOFF,
             )
 
-    async def get_read_version(self, priority: int = 1) -> int:
-        if not CLIENT_KNOBS.GRV_COALESCE:
-            return await self._grv_fetch(priority)
+    async def get_read_version(self, priority: int = 1,
+                               debug_id=None) -> int:
+        # A sampled transaction bypasses client-side coalescing: its GRV
+        # must carry ITS debug ID to the proxy (a piggybacked joiner's ID
+        # would never reach the wire), and sample rates are low enough
+        # that the extra request is noise.
+        if not CLIENT_KNOBS.GRV_COALESCE or debug_id is not None:
+            return await self._grv_fetch(priority, debug_id)
         shared = self._grv_shared.get(priority)
         if shared is None or shared.future.is_set():
             from ..core.runtime import Promise, spawn
@@ -104,9 +109,10 @@ class ClusterConnection:
             spawn(fetch(), name="grvCoalesced")
         return await shared.future
 
-    async def _grv_fetch(self, priority: int) -> int:
+    async def _grv_fetch(self, priority: int, debug_id=None) -> int:
         return await self._retrying(
-            lambda: GetReadVersionRequest(priority=priority),
+            lambda: GetReadVersionRequest(priority=priority,
+                                          debug_id=debug_id),
             self.grv_endpoint, CLIENT_KNOBS.GRV_TIMEOUT,
         )
 

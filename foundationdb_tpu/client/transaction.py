@@ -169,6 +169,14 @@ class Transaction:
             t.cancel()
         self._grv_task = None
         self._read_version_f: Optional[Future] = None
+        # Flight-recorder debug ID (CLIENT_KNOBS.COMMIT_SAMPLE_RATE): a
+        # sampled attempt draws one at its first GRV (or at commit for
+        # blind writes) and the ID rides the GRV + commit requests so
+        # every stage that touches this transaction emits micro events
+        # with it (ref: debugTransaction / commit sampling feeding
+        # g_traceBatch). Per ATTEMPT, like the reference: a retry is a
+        # new timeline.
+        self._debug_id: Optional[str] = None
         self._writes: dict[bytes, _WriteEntry] = {}
         self._clears: list[KeyRange] = []
         self._mutation_log: list[Mutation] = []
@@ -204,11 +212,38 @@ class Transaction:
                 priority = GRV.PRIORITY_IMMEDIATE
             elif self._option(TO.PRIORITY_BATCH):
                 priority = GRV.PRIORITY_BATCH
+            self._maybe_sample_debug_id()
             self._grv_task = spawn(
-                self._db.conn.get_read_version(priority), name="grv"
+                self._db.conn.get_read_version(
+                    priority, debug_id=self._debug_id
+                ),
+                name="grv",
             )
             self._read_version_f = self._grv_task.done
         return self._read_version_f
+
+    # -- flight-recorder sampling --
+    def _maybe_sample_debug_id(self) -> None:
+        """Draw a debug ID for a knob-configured fraction of transactions.
+        Rate 0 (the default) skips the PRNG draw entirely, so unsampled
+        deployments keep a byte-identical commit path AND an untouched
+        seeded-RNG stream under simulation."""
+        if self._debug_id is not None:
+            return
+        rate = CLIENT_KNOBS.COMMIT_SAMPLE_RATE
+        if rate <= 0.0:
+            return
+        loop = current_loop()
+        if rate >= 1.0 or loop.random.random01() < rate:
+            from ..core.trace import new_debug_id
+
+            self._debug_id = new_debug_id()
+
+    @property
+    def debug_id(self) -> Optional[str]:
+        """The attempt's flight-recorder ID (None when unsampled) — what
+        an operator feeds `cli.py trace <debug-id>`."""
+        return self._debug_id
 
     def set_read_version(self, version: int) -> None:
         from ..core.runtime import ready_future
@@ -528,11 +563,15 @@ class Transaction:
         snapshot = 0
         if self._read_conflicts:
             snapshot = await self._read_version_internal()
+        # Blind writes reach commit without ever issuing a GRV: give them
+        # their sampling draw here so write-only traffic is traceable too.
+        self._maybe_sample_debug_id()
         req = CommitTransactionRequest(
             read_snapshot=snapshot,
             read_conflict_ranges=tuple(self._read_conflicts),
             write_conflict_ranges=tuple(self._extra_write_conflicts),
             mutations=tuple(self._mutation_log),
+            debug_id=self._debug_id,
         )
         commit_id = await self._db.conn.commit(req)
         self._committed_version = commit_id.version

@@ -62,6 +62,10 @@ class CommitWireBatch:
     p1_len: np.ndarray     # (M,)  int32
     p2_len: np.ndarray
     blob: bytes
+    # Flight recorder: sparse ((txn_row, debug_id), ...) of the sampled
+    # commits in this batch (resolver/wire.pack_debug_column trailer on
+    # the wire; empty batches add zero bytes).
+    dbg: tuple = ()
 
     @classmethod
     def from_reqs(cls, reqs: Sequence) -> "CommitWireBatch":
@@ -93,14 +97,20 @@ class CommitWireBatch:
         groups = (rb, re_, wb, we, p1, p2)
         lens = [_len_col(g) for g in groups]
         blob = b"".join(b"".join(g) for g in groups)
+        dbg = tuple(
+            (i, r.debug_id) for i, r in enumerate(reqs)
+            if getattr(r, "debug_id", None)
+        )
         return cls(
             n_txns=n, snaps=snaps, r_counts=r_counts, w_counts=w_counts,
             m_counts=m_counts, m_types=m_types,
             rb_len=lens[0], re_len=lens[1], wb_len=lens[2], we_len=lens[3],
-            p1_len=lens[4], p2_len=lens[5], blob=blob,
+            p1_len=lens[4], p2_len=lens[5], blob=blob, dbg=dbg,
         )
 
     def to_bytes(self) -> bytes:
+        from ..resolver.wire import pack_debug_column
+
         nr, nw, nm = len(self.rb_len), len(self.wb_len), len(self.m_types)
         parts = [
             _HEADER.pack(_MAGIC, _VERSION, 0, self.n_txns, nr, nw, nm),
@@ -114,6 +124,9 @@ class CommitWireBatch:
                    self.p1_len, self.p2_len):
             parts.append(np.ascontiguousarray(ln, np.int32).tobytes())
         parts.append(self.blob)
+        # Sparse debug column AFTER the blob (from_bytes re-derives the
+        # blob length from the length columns; unsampled -> zero bytes).
+        parts.append(pack_debug_column(self.dbg))
         return b"".join(parts)
 
     @classmethod
@@ -142,11 +155,18 @@ class CommitWireBatch:
         we_len = take(nw, np.int32)
         p1_len = take(nm, np.int32)
         p2_len = take(nm, np.int32)
+        from ..resolver.wire import unpack_debug_column
+
+        blob_len = sum(
+            int(ln.astype(np.int64).sum())
+            for ln in (rb_len, re_len, wb_len, we_len, p1_len, p2_len)
+        )
         return cls(
             n_txns=n, snaps=snaps, r_counts=r_counts, w_counts=w_counts,
             m_counts=m_counts, m_types=m_types,
             rb_len=rb_len, re_len=re_len, wb_len=wb_len, we_len=we_len,
-            p1_len=p1_len, p2_len=p2_len, blob=data[at:],
+            p1_len=p1_len, p2_len=p2_len, blob=data[at: at + blob_len],
+            dbg=unpack_debug_column(data, at + blob_len),
         )
 
     def to_reqs(self) -> list:
@@ -201,6 +221,8 @@ class CommitWireBatch:
             r_at += ncr
             w_at += ncw
             m_at += ncm
+        for i, did in self.dbg:
+            out[i].debug_id = did
         return out
 
 

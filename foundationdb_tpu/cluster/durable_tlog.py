@@ -208,7 +208,7 @@ class DurableTaggedTLog(TaggedTLog):
 
     # -- the commit path --
     async def commit(self, prev_version: int, version: int, mutations: list,
-                     epoch: int = 0):
+                     epoch: int = 0, debug_id=None):
         """Identical chaining contract to MemoryTLog.commit, but the
         durability step is a real group fsync (ref: tLogCommit waiting
         version order, then doQueueCommit's batched sync)."""
@@ -236,6 +236,9 @@ class DurableTaggedTLog(TaggedTLog):
         # never-durable commit as committed.
         if epoch < self.locked_epoch:
             raise TLogStopped(f"locked by generation {self.locked_epoch}")
+        from ..core.trace import trace_txn_event
+
+        trace_txn_event("TLog.Durable", debug_id, Version=version)
 
     async def _flush_loop(self):
         """Group commit: one fsync covers every batch pushed since the

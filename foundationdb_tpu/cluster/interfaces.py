@@ -39,6 +39,10 @@ class GetReadVersionRequest:
     PRIORITY_IMMEDIATE = 2
 
     priority: int = 1
+    # Flight recorder (CLIENT_KNOBS.COMMIT_SAMPLE_RATE): a sampled
+    # transaction's debug ID — the proxy emits a GRV.Reply micro event
+    # carrying it when the batch answers.
+    debug_id: Optional[str] = None
     reply: Promise = field(default_factory=Promise)
 
 
@@ -62,6 +66,11 @@ class CommitTransactionRequest:
     read_conflict_ranges: Sequence[KeyRange]
     write_conflict_ranges: Sequence[KeyRange]
     mutations: Sequence[Mutation]
+    # Flight recorder (CLIENT_KNOBS.COMMIT_SAMPLE_RATE): client-drawn
+    # debug ID of a sampled transaction. The proxy attaches it to its
+    # commit batch's ID (trace_txn_attach) and the batch ID rides every
+    # downstream hop, so `cli.py trace <id>` stitches the full timeline.
+    debug_id: Optional[str] = None
     reply: Promise = field(default_factory=Promise)
 
 
@@ -123,6 +132,10 @@ class TLogCommitRequest:
     mutations: Sequence[Mutation]
     epoch: int = 0
     wire: Optional[bytes] = None
+    # Flight recorder: the proxy batch's debug ID when the batch holds a
+    # sampled transaction — the log host emits TLog.Durable with it once
+    # its fsync lands, from its own process (cross-process stitching).
+    debug_id: Optional[str] = None
     reply: Promise = field(default_factory=Promise)
 
 
@@ -187,6 +200,11 @@ class ResolveTransactionBatchRequest:
     # in-flight batch must not merge into the successor's conflict state.
     # In-process roles (one per generation by construction) ignore it.
     epoch: int = 0
+    # Flight recorder: the proxy batch's debug ID when the batch holds a
+    # sampled transaction; the resolver emits Resolver.Submit/Verdict
+    # micro events with it (per-txn IDs ride the wire batch's sparse
+    # debug column, resolver/wire.py).
+    debug_id: Optional[str] = None
     reply: Promise = field(default_factory=Promise)
 
 

@@ -291,7 +291,7 @@ class TagPartitionedLogSystem:
     # -- the commit path (ref: push :339) --
     async def push(self, prev_version: int, version: int,
                    tagged_mutations: Sequence[TaggedMutation],
-                   epoch: int = 0) -> None:
+                   epoch: int = 0, debug_id=None) -> None:
         logs = self.logs
         per_log = route_batches(tagged_mutations, len(logs),
                                 self.replica_set_for_tag)
@@ -329,7 +329,7 @@ class TagPartitionedLogSystem:
                         drop = False
                         raise OperationFailed("buggify: log_push_drop")
                     await log.commit(prev_version, version, batch,
-                                     epoch=epoch)
+                                     epoch=epoch, debug_id=debug_id)
                     return
                 except TLogStopped:
                     raise  # fenced by a newer generation: not retryable

@@ -58,6 +58,7 @@ WLTOKEN_LOCATION = 13
 WLTOKEN_COMMIT_BATCH = 14    # columnar CommitBatchRequest (commit_wire.py)
 WLTOKEN_TXN_STATUS = 15      # TxnStatusRequest: commit-plane status pull
 WLTOKEN_CONTROLLER = 16      # worker registration + status/recruitment pulls
+WLTOKEN_TRACE = 17           # TraceEventsRequest: flight-recorder queries
 WLTOKEN_LOG_BASE = 100       # +2*i commit, +2*i+1 control
 WLTOKEN_STORAGE_BASE = 300   # +2*tag read, +2*tag+1 control
 WLTOKEN_RESOLVER_BASE = 500  # host control; +1+idx per-resolver resolve
@@ -191,6 +192,23 @@ class StorageStatusRequest:
 
 
 @dataclass
+class TraceEventsRequest:
+    """Flight-recorder query served by EVERY role host (WLTOKEN_TRACE):
+    matching events from the process's in-memory trace window. `cli.py
+    trace <debug-id>` fans one per process and stitches the replies into
+    a cross-process timeline; `cli.py events` tails the fleet's recent
+    events by type/severity. A debug-ID query matches events carrying
+    the ID (DebugID) AND attach edges pointing at it (To), so the caller
+    can follow a transaction into its commit batch's scope."""
+
+    debug_id: Optional[str] = None
+    event_type: Optional[str] = None
+    min_severity: int = 0
+    last: int = 0
+    reply: Promise = field(default_factory=Promise)
+
+
+@dataclass
 class TxnStatusRequest:
     """Operator/bench pull of the txn host's commit-plane status: the
     proxy's `commit_pipeline` block (grv/form/resolve/tlog stage p50+p99,
@@ -205,10 +223,51 @@ for _cls in (
     TLogPeekRequest, TLogPopRequest, TLogLockRequest, TLogTruncateRequest,
     TLogSkipToRequest, TLogStatusRequest, TLogConfirmEpochRequest,
     TLogHostDurableRequest, StorageRollbackRequest, StorageStatusRequest,
-    TxnStatusRequest, TaggedMutation, InitResolversRequest,
-    ResolverSkipWindowRequest, ResolverStatusRequest, ResolveBatchReply,
+    TxnStatusRequest, TraceEventsRequest, TaggedMutation,
+    InitResolversRequest, ResolverSkipWindowRequest, ResolverStatusRequest,
+    ResolveBatchReply,
 ):
     register_message(_cls)
+
+
+def start_trace_service(transport, tasks: ActorCollection) -> None:
+    """Serve TraceEventsRequest from this process's global TraceSink —
+    the per-process leg of the flight recorder's control-RPC query path
+    (every role host calls this; the in-memory window is bounded by the
+    sink's memory_limit, and `count()` stays exact past it)."""
+    import json as _json
+
+    stream: PromiseStream = PromiseStream()
+    transport.register_endpoint(stream, WLTOKEN_TRACE)
+
+    async def serve(req: TraceEventsRequest):
+        from ..core.trace import global_sink
+
+        sink = global_sink()
+
+        def match(e: dict) -> bool:
+            if req.debug_id is not None and (
+                e.get("DebugID") != req.debug_id
+                and e.get("To") != req.debug_id
+            ):
+                return False
+            if req.event_type is not None and e.get("Type") != req.event_type:
+                return False
+            if req.min_severity and e.get("Severity", 0) < req.min_severity:
+                return False
+            return True
+
+        out = [e for e in sink.events if match(e)]
+        if req.last:
+            out = out[-req.last:]
+        out = out[-5000:]  # reply bound: a flood must not melt the RPC
+        # Details may hold arbitrary objects; the JSON round trip pins
+        # them to codec-safe primitives exactly as the trace file would.
+        out = [_json.loads(_json.dumps(e, default=str)) for e in out]
+        return {"process": sink.process_name, "events": out}
+
+    tasks.add(serve_requests(stream, serve, TaskPriority.DEFAULT,
+                             "traceQuery"))
 
 # Importing the module registers CommitBatchRequest with the wire codec —
 # the txn host must be able to DECODE a client's columnar commit batch
@@ -417,7 +476,8 @@ class LogHost:
         else:
             muts = list(req.mutations)
         await log.commit(req.prev_version, req.version, muts,
-                         epoch=req.epoch)
+                         epoch=req.epoch,
+                         debug_id=getattr(req, "debug_id", None))
         return None
 
     async def _control(self, log, req):
@@ -879,7 +939,7 @@ class RemoteLogSystem:
         return cached
 
     async def push(self, prev_version: int, version: int,
-                   tagged_mutations, epoch: int = 0) -> None:
+                   tagged_mutations, epoch: int = 0, debug_id=None) -> None:
         from .commit_wire import pack_tagged_mutations
         from .log_system import route_batches
 
@@ -894,10 +954,12 @@ class RemoteLogSystem:
                 req = TLogCommitRequest(
                     prev_version, version, (), epoch=epoch,
                     wire=pack_tagged_mutations(tuple(batch)),
+                    debug_id=debug_id,
                 )
             else:
                 req = TLogCommitRequest(prev_version, version,
-                                        tuple(batch), epoch=epoch)
+                                        tuple(batch), epoch=epoch,
+                                        debug_id=debug_id)
             stream.send(req)
             reqs.append(req)
         got = await timeout(
@@ -1723,7 +1785,7 @@ def start_worker_registration(transport, cluster_file: str, role_class: str,
 
 def run_role_host(role_class: str, cluster_file: str, datadir: str,
                   port: int = 0, ready=None, stop_event=None,
-                  machine_id: str = "") -> None:
+                  machine_id: str = "", trace_dir: str = "") -> None:
     """Run one role host on a real-clock loop until stop_event. The host
     merges its listen address into the cluster file; hosts needing peers
     wait for the peers' addresses to appear (discovery via the shared
@@ -1760,14 +1822,42 @@ def run_role_host(role_class: str, cluster_file: str, datadir: str,
             raise ValueError(f"spec knob key {key!r}: registry must be "
                              "'server' or 'client'")
         regs[reg_name].set_knob(name, str(value))
-    # Per-process trace file (the reference's fdbd writes one per process):
-    # operators and tests read role behavior from the datadir.
+    # Per-process trace file (the reference's fdbd writes one per process)
+    # with size-based rolling + retained-file pruning (ref: openTraceFile):
+    # operators and tests read role behavior from the datadir (or a
+    # shared --trace-dir / spec trace_dir, where files are named per
+    # class). The in-memory window stays ON (bounded) — it is what the
+    # WLTOKEN_TRACE flight-recorder queries answer from.
     from ..core.trace import TraceSink, set_global_sink
 
     os.makedirs(datadir, exist_ok=True)
-    set_global_sink(TraceSink(path=os.path.join(datadir, "trace.jsonl"),
-                              keep_in_memory=False))
+    trace_dir = trace_dir or spec.get("trace_dir") or ""
+    if trace_dir:
+        os.makedirs(trace_dir, exist_ok=True)
+        trace_path = os.path.join(trace_dir, f"trace-{role_class}.jsonl")
+    else:
+        trace_path = os.path.join(datadir, "trace.jsonl")
+    sink = set_global_sink(TraceSink(
+        path=trace_path, keep_in_memory=True, memory_limit=50_000,
+        roll_size=SERVER_KNOBS.TRACE_ROLL_SIZE_BYTES,
+        max_retained=SERVER_KNOBS.TRACE_RETAINED_FILES,
+    ))
     loop, transport = real_loop_with_transport(port=port)
+    sink.process_name = f"{role_class}@{transport.local_address}"
+    # Slow-task detection + the sampling profiler feeding its stack
+    # snapshots (ref: Net2's slow-task accounting :570): real-clock role
+    # hosts only — simulated loops never arm the threshold.
+    prof = None
+    if SERVER_KNOBS.SLOW_TASK_THRESHOLD_MS > 0:
+        loop.slow_task_threshold = SERVER_KNOBS.SLOW_TASK_THRESHOLD_MS / 1e3
+        from ..core.profiler import Profiler
+
+        prof = Profiler()
+        try:
+            prof.start(0.02)
+            loop.profiler = prof
+        except Exception:  # pragma: no cover - restricted environments
+            prof = None
     with _loop_ctx(loop):
 
         def stopping() -> bool:
@@ -1792,6 +1882,11 @@ def run_role_host(role_class: str, cluster_file: str, datadir: str,
 
             host = None
             reg_task = None
+            # Flight-recorder query endpoint: EVERY role host serves its
+            # in-memory trace window over WLTOKEN_TRACE so `cli.py trace`
+            # / `events` can stitch cross-process timelines.
+            trace_tasks = ActorCollection()
+            start_trace_service(transport, trace_tasks)
             if role_class in log_keys:
                 idx = log_keys.index(role_class)
                 host = LogHost(transport, f"{datadir}/log",
@@ -1873,10 +1968,14 @@ def run_role_host(role_class: str, cluster_file: str, datadir: str,
             finally:
                 if reg_task is not None:
                     reg_task.cancel()
+                trace_tasks.cancel_all()
                 host.stop()
 
         loop.run(main())
         transport.close()
+    if prof is not None:
+        prof.stop()
+    sink.close()
 
 
 def run_machine(machine_id: str, cluster_file: str, datadir: str,

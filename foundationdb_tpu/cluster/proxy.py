@@ -56,7 +56,12 @@ from ..core.actors import ActorCollection, PromiseStream
 from ..core.errors import NotCommitted, OperationFailed, TLogStopped, TransactionTooOld
 from ..core.knobs import CLIENT_KNOBS, SERVER_KNOBS
 from ..core.runtime import TaskPriority, buggify, current_loop, spawn
-from ..core.trace import TraceEvent
+from ..core.trace import (
+    TraceEvent,
+    new_debug_id,
+    trace_txn_attach,
+    trace_txn_event,
+)
 from ..kv.keys import KeyRange
 from ..resolver.types import COMMITTED, TOO_OLD, TxnConflictInfo
 from .batcher import batcher
@@ -207,6 +212,13 @@ class CommitProxy:
             k: ContinuousSample(256)
             for k in ("grv_ms", "form_ms", "resolve_ms", "tlog_ms")
         }
+        # Latency bands (core/stats.LatencyBands; ref: fdbclient's
+        # latency_bands status): GRV and commit request latencies bucketed
+        # into the knob-configured edges, surfaced per role in status json
+        # and over TxnStatusRequest.
+        from ..core.stats import LatencyBands
+
+        self.latency_bands = {"grv": LatencyBands(), "commit": LatencyBands()}
         self._batch_interval = _AdaptiveBatchInterval()
         # GRV fast path: loop time of the last SUCCESSFUL epoch confirm
         # (None until one lands — the first batch always confirms).
@@ -297,6 +309,9 @@ class CommitProxy:
             "in_flight": len(self._commit_inflight),
             "max_in_flight_measured": self.max_commit_inflight,
             "stages": stage_percentiles(self.commit_stage_samples),
+            "latency_bands": {
+                k: b.status() for k, b in self.latency_bands.items()
+            },
             "batch_interval_ms": round(self._batch_interval.value * 1e3, 3),
             "grv_cache": {
                 "staleness_ms": SERVER_KNOBS.GRV_CACHE_STALENESS_MS,
@@ -426,13 +441,20 @@ class CommitProxy:
         TraceEvent("ProxyGRV").detail("Version", v).detail(
             "Count", len(reqs)
         ).log()
+        answered = 0
         for r in reqs:
             if not r.reply.is_set():
                 self._c_grv.add(1)
                 r.reply.send(v)
-        self.commit_stage_samples["grv_ms"].add_sample(
-            (loop.now() - t0) * 1e3
-        )
+                answered += 1
+                # Flight recorder: a sampled transaction's GRV landed —
+                # the first hop of its stitched timeline.
+                trace_txn_event("GRV.Reply", getattr(r, "debug_id", None),
+                                Version=v, Cached=cached)
+        grv_s = loop.now() - t0
+        self.commit_stage_samples["grv_ms"].add_sample(grv_s * 1e3)
+        if answered:
+            self.latency_bands["grv"].add(grv_s, n=answered)
 
     # -- commit pipeline --
     async def _commit_batch(self, reqs: list[CommitTransactionRequest]):
@@ -459,9 +481,12 @@ class CommitProxy:
         t_start = current_loop().now()
         try:
             await self._commit_batch_impl(reqs, prev_version, version)
-            self._batch_interval.record_latency(
-                current_loop().now() - t_start
-            )
+            batch_s = current_loop().now() - t_start
+            self._batch_interval.record_latency(batch_s)
+            # Band every answered commit at the batch's pipeline latency
+            # (window take -> replies released) — the per-request shape
+            # operators' latency_bands dashboards expect.
+            self.latency_bands["commit"].add(batch_s, n=len(reqs))
         except GeneratorExit:
             # Interpreter GC of a parked coroutine (a dead generation's
             # batch collected during a LATER simulation run): not a
@@ -557,18 +582,26 @@ class CommitProxy:
     def _wire_on(self) -> bool:
         return bool(SERVER_KNOBS.RESOLVER_WIRE_BATCH)
 
-    def _encode_wire(self, txns):
+    def _encode_wire(self, txns, reqs=None):
         """Columnar wire bytes of a resolve batch (resolver/wire.py),
         knob-gated. Built proxy-side — many proxies columnarize
         concurrently, ONE resolver packs, so this moves the per-object
-        walk off the serialized resolve path."""
+        walk off the serialized resolve path. Sampled transactions' debug
+        IDs ride the batch's sparse per-row debug column."""
         if not self._wire_on():
             return None
         from ..resolver.wire import WireBatch
 
-        return WireBatch.from_txns(txns).to_bytes()
+        dbg = ()
+        if reqs is not None:
+            dbg = tuple(
+                (i, r.debug_id) for i, r in enumerate(reqs)
+                if getattr(r, "debug_id", None)
+            )
+        return WireBatch.from_txns(txns, debug_ids=dbg).to_bytes()
 
-    async def _resolve_multi(self, prev_version, version, txns, reqs):
+    async def _resolve_multi(self, prev_version, version, txns, reqs,
+                             debug_id=None):
         """Fan resolution across the resolver partition and merge (ref:
         ResolutionRequestBuilder clipping per resolver,
         MasterProxyServer.actor.cpp:233-312, + the :431-447 merge — any
@@ -598,10 +631,13 @@ class CommitProxy:
                     self._last_receive if i == 0 else prev_version
                 ),
                 transactions=clipped,
-                wire=self._encode_wire(clipped),
+                # clip_txns is positional 1:1 with reqs, so the wire
+                # batch's sparse debug column keeps its row indices.
+                wire=self._encode_wire(clipped, reqs),
                 system_mutations=sys_muts if i == 0 else (),
                 committed_feedback=feedback if i == 0 else (),
                 epoch=self.generation,
+                debug_id=debug_id,
             ))
         async def _one_resolver(role, br):
             if buggify("proxy_resolver_fanout_skew"):
@@ -685,20 +721,23 @@ class CommitProxy:
             out.append(TaggedMutation(tuple(tags) + tuple(self.dr_tags), m))
         return out
 
-    async def _tlog_commit(self, prev_version, version, mutations):
+    async def _tlog_commit(self, prev_version, version, mutations,
+                           debug_id=None):
         if self.log_system is not None:
             await self.log_system.push(
                 prev_version, version, self._tag_mutations(mutations),
-                epoch=self.generation,
+                epoch=self.generation, debug_id=debug_id,
             )
             return
         if self.tlog_endpoint is not None:
             req = TLogCommitRequest(prev_version, version, tuple(mutations),
-                                    epoch=self.generation)
+                                    epoch=self.generation,
+                                    debug_id=debug_id)
             await self._call_endpoint(self.tlog_endpoint, req)
         else:
             await self.tlog.commit(prev_version, version, mutations,
-                                   epoch=self.generation)
+                                   epoch=self.generation,
+                                   debug_id=debug_id)
 
     async def _commit_batch_impl(
         self, reqs: list[CommitTransactionRequest], prev_version: int,
@@ -708,6 +747,23 @@ class CommitProxy:
         TraceEvent("ProxyCommitBatch").detail("Version", version).detail(
             "Txns", len(reqs)
         ).log()
+
+        # Flight recorder: a batch holding sampled transactions draws its
+        # own debug ID (ref: commitBatch's nondeterministic debugID +
+        # g_traceBatch.addAttach("CommitAttachID", ...)); each sampled
+        # txn's ID attaches to it, and the BATCH ID rides every downstream
+        # hop — one client ID reconstructs the whole cross-process,
+        # cross-batch timeline.
+        batch_dbg = None
+        sampled = [r.debug_id for r in reqs
+                   if getattr(r, "debug_id", None)]
+        if sampled:
+            batch_dbg = new_debug_id()
+            trace_txn_event("Commit.BatchFormed", batch_dbg,
+                            Version=version, PrevVersion=prev_version,
+                            Txns=len(reqs), Sampled=len(sampled))
+            for did in sampled:
+                trace_txn_attach(did, batch_dbg, Version=version)
 
         # Versionstamp substitution: the version is known as of phase 1,
         # so SET_VERSIONSTAMPED_* become plain sets BEFORE resolution —
@@ -755,7 +811,7 @@ class CommitProxy:
         ]
         if self.resolvers is not None:
             result = await self._resolve_multi(
-                prev_version, version, txns, reqs
+                prev_version, version, txns, reqs, debug_id=batch_dbg
             )
         elif self.resolver_endpoint is not None:
             # Cross-process hop: ship ONLY the columnar wire form — the
@@ -766,8 +822,9 @@ class CommitProxy:
                 version=version,
                 last_receive_version=prev_version,
                 transactions=[] if self._wire_on() else txns,
-                wire=self._encode_wire(txns),
+                wire=self._encode_wire(txns, reqs),
                 epoch=self.generation,
+                debug_id=batch_dbg,
             )
             result = await self._call_endpoint(
                 self.resolver_endpoint, resolve_req
@@ -778,8 +835,9 @@ class CommitProxy:
                 version=version,
                 last_receive_version=prev_version,
                 transactions=txns,
-                wire=self._encode_wire(txns),
+                wire=self._encode_wire(txns, reqs),
                 epoch=self.generation,
+                debug_id=batch_dbg,
             )
             result = await self.resolver.resolve_batch(resolve_req)
 
@@ -816,10 +874,14 @@ class CommitProxy:
 
         # Phase 4: make the batch durable in version order.
         t_tlog = loop.now()
-        await self._tlog_commit(prev_version, version, mutations)
+        await self._tlog_commit(prev_version, version, mutations,
+                                debug_id=batch_dbg)
         self.commit_stage_samples["tlog_ms"].add_sample(
             (loop.now() - t_tlog) * 1e3
         )
+        # Flight recorder: the FULL fsync quorum acked this window (the
+        # push/commit above resolves only on quorum durability).
+        trace_txn_event("TLog.QuorumAck", batch_dbg, Version=version)
 
         # Phase 5: advance committed version, answer clients — in
         # commit-version order (the _replied chain): with up to
@@ -840,4 +902,5 @@ class CommitProxy:
             else:
                 self._c_conflicted.add(1)
                 r.reply.send_error(NotCommitted())
+        trace_txn_event("Commit.Reply", batch_dbg, Version=version)
         self._advance_replied(version)

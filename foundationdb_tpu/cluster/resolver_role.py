@@ -42,8 +42,8 @@ from collections import deque
 from ..core.actors import NotifiedVersion
 from ..core.errors import OperationFailed
 from ..core.knobs import SERVER_KNOBS
-from ..core.stats import ContinuousSample
-from ..core.trace import TraceEvent
+from ..core.stats import ContinuousSample, LatencyBands
+from ..core.trace import TraceEvent, trace_txn_event
 from ..resolver.types import ConflictBatchResult
 from .interfaces import ResolveTransactionBatchRequest
 
@@ -91,6 +91,9 @@ class ResolverRole:
         self.max_inflight = 0
         # Per-stage timing reservoirs (status json pipeline block).
         self.stage_samples = {k: ContinuousSample(256) for k in _STAGES}
+        # Whole-resolve latency bands (knob-configured edges), surfaced in
+        # the pipeline status block both tiers + ResolverStatusRequest.
+        self.latency_bands = LatencyBands()
         # Counters (ref: Resolver.actor.cpp:155-158 g_counters).
         self.conflict_batches = 0
         self.conflict_transactions = 0
@@ -136,6 +139,7 @@ class ResolverRole:
             "in_flight": len(self._inflight_q),
             "max_in_flight_measured": self.max_inflight,
             "stages": stage_percentiles(self.stage_samples),
+            "latency_bands": self.latency_bands.status(),
         }
 
     def _record_stages(self, handle) -> None:
@@ -241,6 +245,14 @@ class ResolverRole:
             hasattr(self.cs, "submit")
             and SERVER_KNOBS.TPU_PIPELINE_DEPTH > 1
         )
+        # Flight recorder: Submit marks the batch entering the resolver
+        # (depth-gate park + dispatch ahead); Verdict marks verdict
+        # consumption — on the pipelined path their gap IS the
+        # submit->verdicts handle lifetime, the device-resident window.
+        dbg = getattr(req, "debug_id", None)
+        t0 = current_loop().now()
+        trace_txn_event("Resolver.Submit", dbg, Version=req.version,
+                        Txns=n_txns, Pipelined=pipelined)
         if pipelined:
             result = await self._resolve_pipelined(req, wb, n_txns,
                                                    new_oldest)
@@ -251,6 +263,18 @@ class ResolverRole:
         self._retain_state(req)
         n_conflict = sum(1 for s in result.statuses if s != 0)
         self.conflict_transactions += n_conflict
+        self.latency_bands.add(current_loop().now() - t0)
+        trace_txn_event("Resolver.Verdict", dbg, Version=req.version,
+                        Conflicts=n_conflict)
+        if wb is not None:
+            # Per-txn verdicts for the sampled rows riding the wire
+            # batch's sparse debug column: the timeline shows WHICH
+            # sampled transaction conflicted, not just that the batch did.
+            for idx, did in getattr(wb, "dbg", ()):
+                if 0 <= idx < len(result.statuses):
+                    trace_txn_event("Resolver.TxnVerdict", did,
+                                    Version=req.version,
+                                    Status=int(result.statuses[idx]))
         TraceEvent("ResolverBatch").detail("Version", req.version).detail(
             "Transactions", n_txns
         ).detail("Conflicts", n_conflict).log()

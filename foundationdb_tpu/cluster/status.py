@@ -130,7 +130,7 @@ def _sharded_status(cluster) -> dict[str, Any]:
             })
     durable = ls.durable_version()
     for s in cluster.storages:
-        roles.append({
+        role = {
             "role": "storage",
             "tag": s.tag,
             "data_version": s.version.get(),
@@ -138,7 +138,10 @@ def _sharded_status(cluster) -> dict[str, Any]:
             "durability_lag_versions": durable - s.version.get(),
             "excluded": s.tag in cluster.excluded,
             "stored_bytes_estimate": int(s.metrics.byte_sample.total),
-        })
+        }
+        if hasattr(s, "read_bands"):
+            role["read_latency_bands"] = s.read_bands.status()
+        roles.append(role)
 
     from ..kv.keys import KEYSPACE_END
 
@@ -303,6 +306,7 @@ def _local_status(cluster) -> dict[str, Any]:
                 tlog.durable.get() - storage.version.get()
             ),
             "active_watches": len(storage._watches),
+            "read_latency_bands": storage.read_bands.status(),
         },
     ]
 

@@ -76,6 +76,13 @@ class StorageServer:
         # Read endpoint (ref: StorageServerInterface.h:31 — getValue,
         # getKeyValues, watchValue request streams served by one role).
         self.read_stream: PromiseStream = PromiseStream()
+        # Read latency bands (core/stats.LatencyBands; ref: fdbclient's
+        # latency_bands): point + range read service times bucketed into
+        # the knob-configured edges, surfaced in the storage role's
+        # status block.
+        from ..core.stats import LatencyBands
+
+        self.read_bands = LatencyBands()
         self._tasks = []
         if engine is not None:
             self._restore_durable_state()
@@ -177,12 +184,19 @@ class StorageServer:
     #    endpoint works identically in-process and across the sim network --
     async def _serve_one(self, req):
         if isinstance(req, GetValueRequest):
-            return await self.get_value(req)
+            t0 = current_loop().now()
+            out = await self.get_value(req)
+            self.read_bands.add(current_loop().now() - t0)
+            return out
         if isinstance(req, GetRangeRequest):
-            return await self.get_range(req)
+            t0 = current_loop().now()
+            out = await self.get_range(req)
+            self.read_bands.add(current_loop().now() - t0)
+            return out
         if isinstance(req, WatchValueRequest):
             # watch_value resolves req.reply itself on change; returning
-            # its result is harmless (reply already set).
+            # its result is harmless (reply already set). Watches are
+            # open-ended waits, not reads — no latency band.
             return await self.watch_value(req)
         raise TypeError(f"unknown storage request {type(req)}")
 

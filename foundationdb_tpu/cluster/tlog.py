@@ -16,7 +16,7 @@ from __future__ import annotations
 from ..core.actors import NotifiedVersion, PromiseStream, serve_requests
 from ..core.errors import TLogStopped
 from ..core.runtime import buggify, current_loop
-from ..core.trace import TraceEvent
+from ..core.trace import TraceEvent, trace_txn_event
 
 
 class MemoryTLog:
@@ -60,10 +60,13 @@ class MemoryTLog:
         return d
 
     async def commit(self, prev_version: int, version: int, mutations: list,
-                     epoch: int = 0):
+                     epoch: int = 0, debug_id=None):
         """Append one batch's mutations; resolves when durable (ref:
         tLogCommit waits version order then fsyncs via DiskQueue). A commit
-        from a generation older than the lock epoch is refused."""
+        from a generation older than the lock epoch is refused.
+        `debug_id` is the flight recorder's batch ID: a sampled batch
+        emits TLog.Durable from THIS log's process once its copy is
+        durable."""
         if epoch < self.locked_epoch:
             raise TLogStopped(f"locked by generation {self.locked_epoch}")
         await self.version.when_at_least(prev_version)
@@ -91,6 +94,7 @@ class MemoryTLog:
         # report a never-durable commit as committed.
         if epoch < self.locked_epoch:
             raise TLogStopped(f"locked by generation {self.locked_epoch}")
+        trace_txn_event("TLog.Durable", debug_id, Version=version)
 
     def confirm_epoch(self, epoch: int) -> None:
         """confirmEpochLive's per-log check (ref: TagPartitionedLogSystem::
@@ -136,7 +140,8 @@ class MemoryTLog:
                 self.confirm_epoch(req.epoch)
                 return None
             await self.commit(req.prev_version, req.version, req.mutations,
-                              epoch=req.epoch)
+                              epoch=req.epoch,
+                              debug_id=getattr(req, "debug_id", None))
             return None
 
         return serve_requests(self.commit_stream, handle,

@@ -197,6 +197,26 @@ class ServerKnobs(Knobs):
         # Disk queue page size (storage_engine/diskqueue.py derives its
         # on-disk page layout from this at import time).
         init("DISK_QUEUE_PAGE_BYTES", 4096)
+        # Latency bands (core/stats.LatencyBands; ref: fdbclient's
+        # latency_bands status blocks): the millisecond edges GRV/read/
+        # commit/resolve latencies bucket into, per role, surfaced in
+        # `status json` and over TxnStatusRequest/ResolverStatusRequest.
+        init("LATENCY_BAND_EDGES_MS", (1, 2, 5, 10, 25, 50, 100, 250, 1000))
+        # Trace-file lifecycle (core/trace.TraceSink; ref: openTraceFile's
+        # rollsize/maxLogsSize): per-process trace files roll at this many
+        # bytes, keeping the newest TRACE_RETAINED_FILES files (active
+        # file included) — deployed role hosts cannot grow an unbounded
+        # trace on a long-lived machine.
+        init("TRACE_ROLL_SIZE_BYTES", 10 << 20)
+        init("TRACE_RETAINED_FILES", 10)
+        # Event-loop slow-task detection (core/runtime.EventLoop; ref:
+        # Net2's slow-task profiling, flow/Net2.actor.cpp:570): a task
+        # that runs longer than this without yielding emits a SlowTask
+        # TraceEvent (with the sampling profiler's stack snapshot when one
+        # is attached). Real-clock role hosts only — 0 disables, and
+        # simulated loops never arm it (wall-time reads would perturb
+        # nothing, but the event stream must stay seed-pure).
+        init("SLOW_TASK_THRESHOLD_MS", 500.0)
 
 
 class ClientKnobs(Knobs):
@@ -208,6 +228,17 @@ class ClientKnobs(Knobs):
         init("VALUE_SIZE_LIMIT", 100_000)
         init("MAX_BATCH_SIZE", 1000)
         init("GRV_BATCH_INTERVAL", 0.001)
+        # Transaction flight recorder (core/trace.py micro events; ref:
+        # the reference's debugTransaction / commit sampling feeding
+        # g_traceBatch): the fraction of transactions that draw a debug
+        # ID at GRV/commit time. Every stage that touches a sampled txn
+        # emits a TransactionDebug micro event carrying the ID, so one ID
+        # reconstructs the cross-process timeline (`cli.py trace <id>`).
+        # 0 disables sampling AND the per-commit RNG draw, keeping the
+        # default commit path byte-identical to the unsampled plane; sim
+        # seeds randomize it (sim/config.py) and the flight-recorder
+        # tests force it to 1.
+        init("COMMIT_SAMPLE_RATE", 0.0)
         # Client-side GRV coalescing (connection.get_read_version):
         # concurrent same-priority GRVs share one in-flight request while
         # it is unanswered (ref: NativeAPI's readVersionBatcher) — N

@@ -25,6 +25,9 @@ class Profiler:
         self.max_depth = max_depth
         self.samples: Counter = Counter()
         self.total_samples = 0
+        # Most recent interrupted stack (leaf first) — the slow-task
+        # detector attaches it to SlowTask events (core/runtime.py).
+        self.last_stack: tuple = ()
         self._running = False
         self._prev_handler = None
         self._timer = signal.ITIMER_PROF
@@ -37,6 +40,7 @@ class Profiler:
             stack.append(f"{code.co_filename}:{f.f_lineno}:{code.co_name}")
             f = f.f_back
         self.samples[tuple(stack)] += 1
+        self.last_stack = tuple(stack)
         self.total_samples += 1
 
     def start(self, interval: float = 0.01) -> None:

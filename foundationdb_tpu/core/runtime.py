@@ -322,6 +322,17 @@ class EventLoop:
         # loop would otherwise sleep, so socket readiness wakes actors
         # (ref: ASIOReactor::sleepAndReact, flow/Net2.actor.cpp:948).
         self.reactor = None
+        # Slow-task detection (ref: Net2's slow-task accounting,
+        # flow/Net2.actor.cpp:570): a single task step that runs longer
+        # than this many SECONDS without yielding emits a SlowTask
+        # TraceEvent. 0 disables. Real-clock loops only — simulated loops
+        # must never arm it (the emitted events would depend on host
+        # speed, breaking the seed-pure event stream).
+        self.slow_task_threshold = 0.0
+        # Optional core.profiler.Profiler whose most recent SIGPROF stack
+        # snapshot is attached to SlowTask events (the profiler samples
+        # DURING the blocking step; the loop only reads its record).
+        self.profiler = None
 
     # -- time --
     def now(self) -> float:
@@ -371,6 +382,10 @@ class EventLoop:
         self.tasks_run += 1
         prev = self.current_task
         self.current_task = task
+        # fdblint: allow[det-wall-clock] -- slow-task watchdog: armed only on real-clock loops (slow_task_threshold stays 0 under simulation — see multiprocess.run_role_host), and the reading feeds nothing but the SlowTask diagnostic.
+        t_slow = _time.monotonic() if self.slow_task_threshold > 0 else 0.0
+        prof = self.profiler
+        prof_samples0 = prof.total_samples if prof is not None else 0
         try:
             if exc is not None:
                 fut = task.coro.throw(exc)
@@ -400,6 +415,30 @@ class EventLoop:
             fut.add_callback(resume)
         finally:
             self.current_task = prev
+            if self.slow_task_threshold > 0:
+                # fdblint: allow[det-wall-clock] -- slow-task watchdog: real-clock loops only (threshold never set under simulation).
+                dt = _time.monotonic() - t_slow
+                if dt > self.slow_task_threshold:
+                    self._report_slow_task(task, dt, prof, prof_samples0)
+
+    def _report_slow_task(self, task: Task, seconds: float, prof,
+                          prof_samples0: int) -> None:
+        """Emit SlowTask for a step that monopolized the loop (ref: the
+        N2_SlowTask trace Net2 emits with the profiler's evidence). The
+        attached stack is the profiler's most recent SIGPROF sample IF it
+        fired during this step — the interrupted frames name where the
+        blocking time actually went, which the post-hoc task name alone
+        cannot."""
+        from .trace import SevWarn, TraceEvent
+
+        ev = TraceEvent("SlowTask", severity=SevWarn).detail(
+            "TaskName", task.name
+        ).detail("DurationMs", round(seconds * 1e3, 3)).detail(
+            "Priority", task.priority
+        )
+        if prof is not None and prof.total_samples > prof_samples0:
+            ev.detail("Stack", " <- ".join(prof.last_stack))
+        ev.log()
 
     # -- running --
     def stop(self) -> None:

@@ -85,6 +85,43 @@ class ContinuousSample:
         self._sorted = False
 
 
+class LatencyBands:
+    """Latency histogram over knob-configured band edges (ref: the
+    `latency_bands` blocks fdbclient surfaces in status json — GRV/read/
+    commit requests bucketed by operator-chosen thresholds). `status()`
+    renders the reference's cumulative shape: for each edge, how many
+    requests finished within it, plus the unconditional total — the
+    fleet-wide twin of the flight recorder's per-transaction timelines
+    (bands say HOW MANY commits were slow; `cli.py trace` says WHERE one
+    of them spent its time)."""
+
+    __slots__ = ("edges_ms", "_counts", "total")
+
+    def __init__(self, edges_ms=None):
+        if edges_ms is None:
+            from .knobs import SERVER_KNOBS
+
+            edges_ms = SERVER_KNOBS.LATENCY_BAND_EDGES_MS
+        self.edges_ms = tuple(edges_ms)
+        self._counts = [0] * (len(self.edges_ms) + 1)
+        self.total = 0
+
+    def add(self, seconds: float, n: int = 1) -> None:
+        import bisect
+
+        self._counts[bisect.bisect_left(self.edges_ms, seconds * 1e3)] += n
+        self.total += n
+
+    def status(self) -> dict:
+        bands = {}
+        acc = 0
+        for edge, c in zip(self.edges_ms, self._counts):
+            acc += c
+            bands[f"{edge:g}"] = acc
+        bands["inf"] = self.total
+        return {"bands_ms": bands, "total": self.total}
+
+
 def stage_percentiles(samples: dict) -> dict:
     """{stage: {"p50", "p99", "samples"}} from a dict of ContinuousSample
     reservoirs — the shared shape of the resolver's and the commit

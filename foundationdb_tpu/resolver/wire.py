@@ -52,6 +52,42 @@ def _key_columns(keys: list) -> tuple[np.ndarray, bytes]:
     return lens, b"".join(keys)
 
 
+def pack_debug_column(dbg) -> bytes:
+    """Sparse per-row debug-ID column (flight recorder): rows carrying a
+    sampled transaction's ID encode as (count, int32 row indices, int32
+    id lengths, ascii id blob). Empty -> b"", so unsampled batches add
+    ZERO wire bytes — the column is a trailer after the key blob, whose
+    length both formats re-derive from their length columns."""
+    dbg = tuple(dbg or ())
+    if not dbg:
+        return b""
+    ids = [str(d).encode("ascii") for _, d in dbg]
+    idx = np.fromiter((i for i, _ in dbg), np.int32, count=len(dbg))
+    lens = np.fromiter(map(len, ids), np.int32, count=len(ids))
+    return b"".join([
+        struct.pack("<I", len(dbg)), idx.tobytes(), lens.tobytes(),
+        b"".join(ids),
+    ])
+
+
+def unpack_debug_column(data: bytes, offset: int = 0) -> tuple:
+    """Inverse of pack_debug_column; ((row, id), ...) — empty input (an
+    unsampled batch, or a peer that did not append the trailer) decodes
+    to ()."""
+    if offset >= len(data):
+        return ()
+    (n,) = struct.unpack_from("<I", data, offset)
+    at = offset + 4
+    idx = np.frombuffer(data, np.int32, n, at); at += 4 * n
+    lens = np.frombuffer(data, np.int32, n, at); at += 4 * n
+    out = []
+    for i in range(n):
+        ln = int(lens[i])
+        out.append((int(idx[i]), data[at: at + ln].decode("ascii")))
+        at += ln
+    return tuple(out)
+
+
 @dataclass
 class WireBatch:
     """One conflict batch as columns (see module docstring). Offsets are
@@ -71,11 +107,16 @@ class WireBatch:
     we_off: np.ndarray
     we_len: np.ndarray
     blob: np.ndarray       # (B,)  uint8
+    # Flight recorder: sparse ((txn_row, debug_id), ...) of the sampled
+    # transactions in this batch (empty for unsampled batches; never
+    # touches the packing fast path).
+    dbg: tuple = ()
 
     # -- construction --
 
     @classmethod
-    def from_txns(cls, txns: Sequence[TxnConflictInfo]) -> "WireBatch":
+    def from_txns(cls, txns: Sequence[TxnConflictInfo],
+                  debug_ids=()) -> "WireBatch":
         """Columnarize transaction objects (the proxy-side encoder; one
         linear pass, OFF the resolver's serialized commit path — many
         proxies columnarize concurrently, one resolver packs)."""
@@ -108,7 +149,7 @@ class WireBatch:
             n_txns=n, snaps=snaps, r_counts=r_counts, w_counts=w_counts,
             rb_off=offs[0], rb_len=lens[0], re_off=offs[1], re_len=lens[1],
             wb_off=offs[2], wb_len=lens[2], we_off=offs[3], we_len=lens[3],
-            blob=blob,
+            blob=blob, dbg=tuple(debug_ids or ()),
         )
 
     # -- wire round trip --
@@ -133,6 +174,10 @@ class WireBatch:
             )
             blob_parts.append(_gather_blob(self.blob, off, ln))
         parts.extend(blob_parts)
+        # Sparse debug column rides AFTER the key blob (whose length
+        # from_bytes re-derives from the length columns); unsampled
+        # batches append nothing.
+        parts.append(pack_debug_column(self.dbg))
         return b"".join(parts)
 
     @classmethod
@@ -167,11 +212,12 @@ class WireBatch:
         ]
         blob = np.frombuffer(data, dtype=np.uint8, count=int(sizes.sum()),
                              offset=at)
+        dbg = unpack_debug_column(data, at + int(sizes.sum()))
         return cls(
             n_txns=n, snaps=snaps, r_counts=r_counts, w_counts=w_counts,
             rb_off=offs[0], rb_len=rb_len, re_off=offs[1], re_len=re_len,
             wb_off=offs[2], wb_len=wb_len, we_off=offs[3], we_len=we_len,
-            blob=blob,
+            blob=blob, dbg=dbg,
         )
 
     # -- views --
@@ -195,6 +241,7 @@ class WireBatch:
             wb_off=self.wb_off[w0:w1], wb_len=self.wb_len[w0:w1],
             we_off=self.we_off[w0:w1], we_len=self.we_len[w0:w1],
             blob=self.blob,
+            dbg=tuple((i - lo, d) for i, d in self.dbg if lo <= i < hi),
         )
 
     def to_txns(self) -> list[TxnConflictInfo]:
@@ -398,4 +445,6 @@ __all__ = [
     "pack_wire",
     "chunk_bounds",
     "pack_keys",
+    "pack_debug_column",
+    "unpack_debug_column",
 ]

@@ -154,7 +154,8 @@ def run_role_host(args) -> int:
 
     threading.Thread(target=announce, daemon=True).start()
     _run(args.process_class, args.cluster_file, args.datadir,
-         ready=ready, stop_event=stop, machine_id=args.machine_id or "")
+         ready=ready, stop_event=stop, machine_id=args.machine_id or "",
+         trace_dir=args.trace_dir or "")
     return 0
 
 
@@ -204,6 +205,11 @@ def main(argv=None) -> int:
     ap.add_argument("-C", "--cluster-file",
                     help="shared cluster file (multi-process discovery)")
     ap.add_argument("-d", "--datadir", help="data directory (durable tier)")
+    ap.add_argument("--trace-dir", default="",
+                    help="fdbd --class: directory for this process's "
+                         "rolling trace files (trace-<class>.jsonl; "
+                         "default: <datadir>/trace.jsonl). The spec's "
+                         "trace_dir key sets it fleet-wide.")
     ap.add_argument("--knob", action="append", default=[],
                     metavar="NAME=VALUE", help="set a knob (repeatable)")
     args = ap.parse_args(argv)

@@ -86,6 +86,12 @@ _KNOB_RANGES = [
     ("WORKER_HEARTBEAT_INTERVAL", "server", (0.1, 1.0)),
     ("WORKER_LEASE_TIMEOUT", "server", (0.5, 4.0)),
     ("RECRUITMENT_STALL_RETRY_DELAY", "server", (0.05, 1.0)),
+    # r10: flight-recorder sampling — 0 pins the unsampled commit path
+    # (no per-commit RNG draw at all); positive draws thread debug IDs
+    # through GRV/commit/resolve/tlog under the seed's chaos mix, so the
+    # micro-event emission points and the wire debug columns run inside
+    # the determinism contract (same seed => bit-identical event chain).
+    ("COMMIT_SAMPLE_RATE", "client", (0.0, 1.0)),
 ]
 
 # Categorical knob draws (same subset-randomization policy as the ranges).
@@ -184,6 +190,8 @@ def generate_config(seed: int) -> dict[str, Any]:
         {"name": "VersionStamp", "clients": rng.randint(2, 4),
          "txns": rng.randint(5, 12)},
         {"name": "BackupRestore", "snapshots": 2},
+        {"name": "StatusWorkload", "fetches": rng.randint(3, 8),
+         "interval": round(0.1 + 0.4 * rng.random(), 2)},
     ]
     rng.shuffle(optional)
     workloads.extend(optional[: rng.randint(1, 3)])

@@ -325,6 +325,18 @@ async def _run_workloads(cluster, db, spec) -> dict[str, Any]:
             ).start()
             starters.append((rkey, wl.done))
             checkers.append((rkey, wl.check, wl.metrics))
+        elif name == "StatusWorkload":
+            # Status-schema probe mid-chaos (ref: StatusWorkload.actor.cpp
+            # — the document must render AND conform while the fault
+            # workloads run; see workloads/status_workload.py).
+            from .status_workload import StatusWorkload
+
+            wl = StatusWorkload(cluster, interval=w.get("interval", 0.3),
+                                fetches=w.get("fetches", 5))
+            starters.append((rkey, spawn(wl.run()).done))
+            checkers.append((rkey, wl.check,
+                             lambda wl=wl: {"fetches": wl.fetches_done,
+                                            "violations": wl.failures[:3]}))
         elif name == "DataDistribution":
             dd = cluster.start_data_distribution(
                 interval=w.get("interval", 0.2)
@@ -498,7 +510,8 @@ def run_restart_spec(spec: dict) -> dict[str, Any]:
             finally:
                 loop.shutdown()
                 undo_knobs()
-        pres["sev_errors"] = len(global_sink().has_severity(40))
+        pres["sev_errors"] = global_sink().error_count
+        pres["sev_error_events"] = list(global_sink().error_events[:50])
         results["phases"].append(pres)
 
     results["ok"] = all(
@@ -506,6 +519,9 @@ def run_restart_spec(spec: dict) -> dict[str, Any]:
         for p in results["phases"]
     )
     results["sev_errors"] = sum(p["sev_errors"] for p in results["phases"])
+    results["sev_error_events"] = [
+        e for p in results["phases"] for e in p.get("sev_error_events", [])
+    ][:50]
     return results
 
 
@@ -576,5 +592,11 @@ def run_spec(spec: dict) -> dict[str, Any]:
         finally:
             loop.shutdown()
             undo_knobs()
-    results["sev_errors"] = len(global_sink().has_severity(40))
+    # EXACT SevError accounting (TraceSink keeps a trim-immune record):
+    # the count can no longer silently shrink on long runs whose event
+    # window trimmed, and the events themselves ride the result so
+    # tools/seed_sweep.py can allowlist expected types and PRINT the
+    # offenders in its repro block.
+    results["sev_errors"] = global_sink().error_count
+    results["sev_error_events"] = list(global_sink().error_events[:50])
     return results

@@ -54,9 +54,10 @@ def test_proxy_pipeline_depth_measured_and_replies_in_order(sim, knob):
     # exists to overlap.
     orig_tlog = cluster.proxy._tlog_commit
 
-    async def slow_tlog(prev_version, version, mutations):
+    async def slow_tlog(prev_version, version, mutations, debug_id=None):
         await current_loop().delay(0.005)
-        return await orig_tlog(prev_version, version, mutations)
+        return await orig_tlog(prev_version, version, mutations,
+                               debug_id=debug_id)
 
     cluster.proxy._tlog_commit = slow_tlog
     reply_versions = []

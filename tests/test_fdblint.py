@@ -529,6 +529,47 @@ def test_knob_clean_tree(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# pack: trace hygiene
+# ---------------------------------------------------------------------------
+
+def test_trace_unlogged_bad(tmp_path):
+    fs = run_lint(tmp_path, {SIM: """
+        from foundationdb_tpu.core.trace import TraceEvent
+        def f(n, err):
+            TraceEvent("Dropped").detail("N", n)
+            TraceEvent("Bare")
+            TraceEvent("ChainedError", severity=30).error(err).detail("N", n)
+    """})
+    assert [f.rule for f in fs if not f.suppressed] == ["trace-unlogged"] * 3
+
+
+def test_trace_unlogged_good_shapes(tmp_path):
+    fs = run_lint(tmp_path, {SIM: """
+        from foundationdb_tpu.core.trace import TraceEvent
+        def f(n):
+            TraceEvent("Logged").detail("N", n).log()
+            with TraceEvent("Ctx") as ev:
+                ev.detail("N", n)
+            held = TraceEvent("Assigned")
+            held.detail("N", n)
+            held.log()
+            return TraceEvent("Returned")
+    """})
+    assert rules_of(fs) == []
+
+
+def test_trace_unlogged_scoped_to_project(tmp_path):
+    # Test/tool fixtures construct events deliberately; the rule stays
+    # inside foundationdb_tpu/ like the determinism pack.
+    fs = run_lint(tmp_path, {"tests/helper.py": """
+        from foundationdb_tpu.core.trace import TraceEvent
+        def f():
+            TraceEvent("DeliberatelyDropped")
+    """})
+    assert rules_of(fs) == []
+
+
+# ---------------------------------------------------------------------------
 # pragmas, baseline, output modes
 # ---------------------------------------------------------------------------
 

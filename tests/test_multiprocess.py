@@ -383,6 +383,77 @@ def test_resolver_host_and_balancer_over_the_wire(tmp_path):
     )
 
 
+def test_flight_recorder_end_to_end(tmp_path):
+    """ISSUE 10 acceptance: with sampling forced on, commit through a
+    real 4-process cluster (log / storage / resolver / txn), then
+    `cli.py trace <debug-id>` attached via --cluster-file returns a
+    stitched timeline containing GRV, batch-attach, resolver
+    submit/verdict, tlog durability + quorum-ack, and reply events from
+    >= 3 distinct processes, with monotonically ordered per-hop
+    timestamps."""
+    classes = ("log", "storage", "resolver", "txn")
+    cf, procs = _launch(tmp_path, classes, spec_extra={"n_resolvers": 1})
+    from foundationdb_tpu.core.knobs import CLIENT_KNOBS
+
+    try:
+        CLIENT_KNOBS.COMMIT_SAMPLE_RATE = 1.0
+
+        async def body(db):
+            # A read forces a GRV carrying the debug ID; the write makes
+            # the commit traverse resolve + tlog.
+            tr = db.create_transaction()
+            await tr.get(b"fr/key")
+            tr.set(b"fr/key", b"v1")
+            await tr.commit()
+            return tr.debug_id
+
+        debug_id = _client_run(cf, body)
+        assert debug_id, "sampled transaction drew no debug ID"
+
+        from foundationdb_tpu.cli import Cli
+
+        cli = Cli(cluster_file=cf)
+        try:
+            timeline = cli.trace_timeline(debug_id)
+            rendered = cli.execute(f"trace {debug_id}")
+            tailed = cli.execute("events --type TransactionAttach --last 5")
+        finally:
+            cli.close()
+    finally:
+        CLIENT_KNOBS.COMMIT_SAMPLE_RATE = 0.0
+        _teardown(procs)
+
+    assert timeline, "no flight-recorder events returned"
+    procs_seen = {p for p, _ in timeline}
+    assert len(procs_seen) >= 3, procs_seen
+    micro = [e for _, e in timeline if e["Type"] == "TransactionDebug"]
+    locs = {e["Location"] for e in micro}
+    for hop in ("GRV.Reply", "Commit.BatchFormed", "Resolver.Submit",
+                "Resolver.Verdict", "TLog.Durable", "TLog.QuorumAck",
+                "Commit.Reply"):
+        assert hop in locs, f"missing hop {hop} (have {sorted(locs)})"
+    assert any(e["Type"] == "TransactionAttach" and e["DebugID"] == debug_id
+               for _, e in timeline), "txn->batch attach edge missing"
+    # The stitched timeline is time-sorted, and the per-hop first
+    # occurrences follow commit-path causal order across processes
+    # (wall-clock stamps of one machine's processes).
+    times = [e["Time"] for _, e in timeline]
+    assert times == sorted(times)
+
+    def first(loc):
+        return min(e["Time"] for e in micro if e["Location"] == loc)
+
+    assert (first("GRV.Reply") <= first("Commit.BatchFormed")
+            <= first("Resolver.Submit") <= first("Resolver.Verdict")
+            <= first("TLog.Durable") <= first("TLog.QuorumAck")
+            <= first("Commit.Reply"))
+    # The operator rendering carries the hop names + process identities.
+    assert "Resolver.Submit" in rendered and "TLog.QuorumAck" in rendered
+    assert any("resolver@" in line for line in rendered.splitlines())
+    # The fleet-tail verb found the attach edge too.
+    assert "TransactionAttach" in tailed
+
+
 def test_double_log_replication_survives_datadir_destruction(tmp_path):
     """The acceptance contract on the REAL-PROCESS tier: under `double`
     log replication across two log-host failure domains, SIGKILL one

@@ -1,0 +1,99 @@
+"""ISSUE 10 satellite: StatusWorkload — `status json` fetched mid-chaos
+and validated against the checked-in schema (ref:
+workloads/StatusWorkload.actor.cpp). The seeded-break tests are the
+development-time proof the validator actually bites: each class of
+schema regression (dropped key, retyped value, missing observability
+block, malformed role list) must be CAUGHT, not rendered."""
+
+from __future__ import annotations
+
+import copy
+
+from foundationdb_tpu.workloads.status_workload import (
+    validate_roles,
+    validate_status,
+)
+
+
+def _live_status_doc():
+    from foundationdb_tpu.cluster.cluster import LocalCluster
+    from foundationdb_tpu.cluster.status import cluster_status
+    from foundationdb_tpu.core.runtime import loop_context, sim_loop
+
+    loop = sim_loop(seed=5)
+    with loop_context(loop):
+        async def main():
+            cluster = LocalCluster().start()
+            db = cluster.database()
+            await db.set(b"sw", b"1")
+            st = cluster_status(cluster)
+            cluster.stop()
+            return st
+
+        return loop.run(main())
+
+
+def test_live_status_conforms():
+    doc = _live_status_doc()
+    assert validate_status(doc) == []
+    assert validate_roles(doc) == []
+
+
+def test_seeded_break_missing_key_is_caught():
+    doc = _live_status_doc()
+    del doc["cluster"]["workload"]["transactions"]["committed"]
+    errs = validate_status(doc)
+    assert any("committed" in e and "missing" in e for e in errs)
+
+
+def test_seeded_break_retyped_value_is_caught():
+    doc = _live_status_doc()
+    doc["cluster"]["latest_version"] = "not-a-version"
+    errs = validate_status(doc)
+    assert any("latest_version" in e and "expected int" in e for e in errs)
+
+
+def test_seeded_break_dropped_latency_bands_is_caught():
+    doc = _live_status_doc()
+    for r in doc["cluster"]["roles"]:
+        if r["role"] == "proxy":
+            del r["commit_pipeline"]["latency_bands"]
+    errs = validate_roles(doc)
+    assert any("latency_bands" in e for e in errs)
+
+
+def test_seeded_break_missing_proxy_role_is_caught():
+    doc = _live_status_doc()
+    doc["cluster"]["roles"] = [
+        r for r in doc["cluster"]["roles"] if r["role"] != "proxy"
+    ]
+    errs = validate_roles(doc)
+    assert any("no proxy role" in e for e in errs)
+
+
+def test_extra_keys_are_not_violations():
+    doc = _live_status_doc()
+    doc["cluster"]["future_field"] = {"anything": 1}
+    doc2 = copy.deepcopy(doc)
+    assert validate_status(doc2) == []
+
+
+def test_status_workload_runs_in_spec_mid_chaos():
+    """The workload fetches + validates WHILE Attrition kills the txn
+    system — the document must render mid-recovery too."""
+    from foundationdb_tpu.workloads.tester import run_spec
+
+    res = run_spec({
+        "seed": 21, "buggify": True,
+        "cluster": {"kind": "recoverable_sharded", "n_storage": 3,
+                    "n_logs": 2, "replication": "double"},
+        "workloads": [
+            {"name": "Cycle", "nodes": 8, "clients": 2, "txns": 8},
+            {"name": "Attrition", "interval": 0.4, "kills": 1},
+            {"name": "StatusWorkload", "fetches": 4, "interval": 0.2},
+        ],
+    })
+    assert res["ok"], res
+    sw = res["StatusWorkload"]
+    assert sw["ok"] and sw["metrics"]["fetches"] >= 1
+    assert not sw["metrics"]["violations"]

@@ -1,0 +1,405 @@
+"""ISSUE 10: transaction flight recorder + trace-file lifecycle.
+
+Covers the tentpole's sim-tier acceptance twin (same seed => the
+debug-ID micro-event chain replays bit-identically, with causally
+ordered per-hop timestamps) and the satellite lifecycle coverage:
+size-based rolling + retention pruning, flood suppression emitting
+exactly one marker per type, exact count()/flagged find() across the
+in-memory trim, the profiler's SIGPROF -> ITIMER_REAL fallback restoring
+the prior handler, slow-task detection under a deliberately blocking
+task, and the latency-band blocks in status json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import time
+
+import pytest
+
+from foundationdb_tpu.core.trace import (
+    SevWarn,
+    TraceEvent,
+    TraceSink,
+    global_sink,
+    set_global_sink,
+)
+
+
+@pytest.fixture()
+def fresh_sink():
+    old = global_sink()
+    sink = set_global_sink(TraceSink())
+    try:
+        yield sink
+    finally:
+        set_global_sink(old)
+
+
+# ---------------------------------------------------------------------------
+# trace-file lifecycle: rolling + retention
+# ---------------------------------------------------------------------------
+
+def test_sink_rolls_at_size_and_prunes_retained(tmp_path):
+    path = str(tmp_path / "trace.jsonl")
+    sink = TraceSink(path=path, roll_size=2_000, max_retained=3)
+    for i in range(400):
+        sink.emit({"Type": "Fill", "Severity": 10, "N": i, "Pad": "x" * 40})
+    sink.close()
+    rolled = sorted(
+        f for f in os.listdir(tmp_path) if f.startswith("trace.jsonl.")
+    )
+    # Retention: active file + at most (max_retained - 1) rolled files.
+    assert os.path.exists(path)
+    assert 1 <= len(rolled) <= 2, rolled
+    # Every retained file is valid JSONL of the newest events.
+    seen = []
+    for f in rolled + ["trace.jsonl"]:
+        with open(tmp_path / f) as fh:
+            for line in fh:
+                seen.append(json.loads(line))
+    ns = [e["N"] for e in seen if e["Type"] == "Fill"]
+    assert ns == sorted(ns)
+    assert ns[-1] == 399          # newest survived
+    assert ns[0] > 0              # oldest was pruned with its rolled file
+
+
+def test_sink_resumes_roll_seq_across_reopen(tmp_path):
+    path = str(tmp_path / "trace.jsonl")
+    for _round in range(2):
+        sink = TraceSink(path=path, roll_size=500, max_retained=10)
+        for i in range(100):
+            sink.emit({"Type": "Fill", "N": i, "Pad": "y" * 30})
+        sink.close()
+    rolled = [f for f in os.listdir(tmp_path) if f.startswith("trace.jsonl.")]
+    # A reopened sink continues the sequence instead of clobbering.
+    assert len(rolled) >= 2
+    assert len(set(rolled)) == len(rolled)
+
+
+# ---------------------------------------------------------------------------
+# count()/find() across the in-memory trim (satellite 1)
+# ---------------------------------------------------------------------------
+
+def test_count_exact_and_find_flags_truncation():
+    sink = TraceSink(memory_limit=100)
+    for i in range(500):
+        sink.emit({"Type": "Churn", "Severity": 10, "N": i})
+    # The window trimmed, but count() reads the retained totals.
+    assert sink.count("Churn") == 500
+    found = sink.find("Churn")
+    assert len(found) < 500
+    assert found.truncated == 500 - len(found)
+    # An untrimmed type reports complete results.
+    sink.emit({"Type": "Rare", "Severity": 10})
+    rare = sink.find("Rare")
+    assert len(rare) == 1 and rare.truncated == 0
+    assert sink.count("Rare") == 1
+
+
+def test_sev_error_record_is_trim_immune():
+    sink = TraceSink(memory_limit=50)
+    sink.emit({"Type": "EarlyError", "Severity": 40})
+    for i in range(500):
+        sink.emit({"Type": "Churn", "Severity": 10, "N": i})
+    assert sink.error_count == 1
+    assert [e["Type"] for e in sink.has_severity(40)] == ["EarlyError"]
+
+
+# ---------------------------------------------------------------------------
+# flood suppression: exactly one marker per type
+# ---------------------------------------------------------------------------
+
+def test_flood_suppression_single_marker_per_type():
+    sink = TraceSink(memory_limit=200_000)
+    n = TraceSink.TYPE_LIMIT + 500
+    for i in range(n):
+        sink.emit({"Type": "Flood", "Severity": 10, "N": i})
+        sink.emit({"Type": "Flood2", "Severity": 10, "N": i})
+    markers = [e for e in sink.events if e["Type"] == "TraceEventsSuppressed"]
+    assert sorted(m["SuppressedType"] for m in markers) == ["Flood", "Flood2"]
+    assert sink.suppressed["Flood"] == 500
+    assert sink.count("Flood") == TraceSink.TYPE_LIMIT
+    # SevError+ is never suppressed.
+    for i in range(TraceSink.TYPE_LIMIT + 10):
+        sink.emit({"Type": "LoudError", "Severity": 40, "N": i})
+    assert sink.count("LoudError") == TraceSink.TYPE_LIMIT + 10
+    assert "LoudError" not in sink.suppressed
+
+
+# ---------------------------------------------------------------------------
+# profiler fallback restores the prior handler (satellite 5)
+# ---------------------------------------------------------------------------
+
+def test_profiler_fallback_restores_prior_sigalrm_handler(monkeypatch):
+    from foundationdb_tpu.core.profiler import Profiler
+
+    real_setitimer = signal.setitimer
+
+    def prof_unavailable(which, *a):
+        if which == signal.ITIMER_PROF:
+            raise OSError("ITIMER_PROF unavailable (restricted env)")
+        return real_setitimer(which, *a)
+
+    monkeypatch.setattr(signal, "setitimer", prof_unavailable)
+    sentinel_calls = []
+
+    def sentinel(signum, frame):
+        sentinel_calls.append(signum)
+
+    prev = signal.signal(signal.SIGALRM, sentinel)
+    try:
+        p = Profiler()
+        p.start(0.05)
+        assert p._timer == signal.ITIMER_REAL  # fallback engaged
+        assert signal.getsignal(signal.SIGALRM) == p._handler
+        p.stop()
+        # The PRIOR handler (our sentinel) is back after stop().
+        assert signal.getsignal(signal.SIGALRM) is sentinel
+    finally:
+        signal.signal(signal.SIGALRM, prev)
+
+
+def test_profiler_start_stop_prof_path_restores_handler():
+    from foundationdb_tpu.core.profiler import Profiler
+
+    prev = signal.getsignal(signal.SIGPROF)
+    p = Profiler()
+    p.start(0.005)
+    busy = 0
+    deadline = time.time() + 0.2
+    while time.time() < deadline:
+        busy += 1
+    p.stop()
+    assert signal.getsignal(signal.SIGPROF) == prev
+    assert p.total_samples > 0
+    assert p.last_stack  # the SlowTask detector's snapshot source
+
+
+# ---------------------------------------------------------------------------
+# slow-task detection (real-clock loops only)
+# ---------------------------------------------------------------------------
+
+def test_slow_task_detection_fires_on_blocking_task(fresh_sink):
+    from foundationdb_tpu.core.profiler import Profiler
+    from foundationdb_tpu.core.runtime import EventLoop, loop_context
+
+    loop = EventLoop()  # real clock
+    loop.slow_task_threshold = 0.02
+    prof = Profiler()
+    prof.start(0.005)
+    loop.profiler = prof
+    try:
+        with loop_context(loop):
+            async def blocker():
+                t0 = time.time()
+                while time.time() - t0 < 0.08:
+                    pass  # deliberately never yields
+
+            loop.run(blocker())
+    finally:
+        prof.stop()
+    slow = fresh_sink.find("SlowTask")
+    assert slow, "blocking task did not trigger SlowTask"
+    ev = slow[-1]
+    assert ev["DurationMs"] >= 20
+    assert ev["Severity"] == SevWarn
+    assert "Stack" in ev  # the profiler sampled during the block
+
+
+def test_slow_task_never_armed_under_simulation(fresh_sink):
+    from foundationdb_tpu.core.runtime import loop_context, sim_loop
+
+    loop = sim_loop(seed=3)
+    assert loop.slow_task_threshold == 0.0
+    with loop_context(loop):
+        async def main():
+            t0 = time.time()
+            while time.time() - t0 < 0.03:
+                pass
+
+        loop.run(main())
+    assert not fresh_sink.find("SlowTask")
+
+
+# ---------------------------------------------------------------------------
+# latency bands
+# ---------------------------------------------------------------------------
+
+def test_latency_bands_cumulative_shape():
+    from foundationdb_tpu.core.stats import LatencyBands
+
+    b = LatencyBands(edges_ms=(1, 10, 100))
+    for ms in (0.5, 0.9, 5, 50, 500):
+        b.add(ms / 1e3)
+    st = b.status()
+    assert st["total"] == 5
+    assert st["bands_ms"] == {"1": 2, "10": 3, "100": 4, "inf": 5}
+
+
+def test_latency_bands_in_status_json():
+    """Both new observability blocks render on a live sim cluster: the
+    proxy's grv/commit bands and the resolver's resolve band, plus the
+    storage read bands — and the StatusWorkload schema accepts the doc."""
+    from foundationdb_tpu.cluster.cluster import LocalCluster
+    from foundationdb_tpu.cluster.status import cluster_status
+    from foundationdb_tpu.core.runtime import loop_context, sim_loop
+    from foundationdb_tpu.workloads.status_workload import (
+        validate_roles,
+        validate_status,
+    )
+
+    loop = sim_loop(seed=11)
+    with loop_context(loop):
+        async def main():
+            cluster = LocalCluster().start()
+            db = cluster.database()
+            for i in range(5):
+                await db.set(b"lb%d" % i, b"v")
+                await db.get(b"lb%d" % i)
+            st = cluster_status(cluster)
+            cluster.stop()
+            return st
+
+        st = loop.run(main())
+    roles = {r["role"]: r for r in st["cluster"]["roles"]}
+    bands = roles["proxy"]["commit_pipeline"]["latency_bands"]
+    assert bands["commit"]["total"] >= 5
+    assert bands["grv"]["total"] >= 1
+    assert roles["resolver"]["pipeline"]["latency_bands"]["total"] >= 1
+    assert roles["storage"]["read_latency_bands"]["total"] >= 1
+    assert validate_status(st) == []
+    assert validate_roles(st) == []
+
+
+# ---------------------------------------------------------------------------
+# the sim-tier flight-recorder twin (tentpole acceptance)
+# ---------------------------------------------------------------------------
+
+_HOPS = ("GRV.Reply", "Commit.BatchFormed", "Resolver.Submit",
+         "Resolver.Verdict", "TLog.Durable", "TLog.QuorumAck",
+         "Commit.Reply")
+
+
+def _spec():
+    return {
+        "seed": 1234, "buggify": True,
+        "knobs": {"client:COMMIT_SAMPLE_RATE": 1.0},
+        "cluster": {"kind": "recoverable_sharded", "n_storage": 3,
+                    "n_logs": 2, "replication": "double"},
+        "workloads": [
+            {"name": "Cycle", "nodes": 8, "clients": 2, "txns": 5},
+        ],
+    }
+
+
+def _micro_chain():
+    from foundationdb_tpu.workloads.tester import run_spec
+
+    res = run_spec(_spec())
+    assert res["ok"] and not res["sev_errors"]
+    return [e for e in global_sink().events
+            if e["Type"] in ("TransactionDebug", "TransactionAttach")]
+
+
+def test_flight_recorder_chain_complete_and_causally_ordered():
+    chain = _micro_chain()
+    locs = {e.get("Location") for e in chain}
+    for hop in _HOPS:
+        assert hop in locs, f"missing hop {hop}"
+    attaches = [e for e in chain if e["Type"] == "TransactionAttach"]
+    assert attaches, "no txn->batch attach events"
+    # Per-batch causal ordering: for every batch debug ID, the hops
+    # appear in commit-path order of sim time.
+    order = {h: i for i, h in enumerate(
+        ("Commit.BatchFormed", "Resolver.Submit", "Resolver.Verdict",
+         "TLog.Durable", "TLog.QuorumAck", "Commit.Reply"))}
+    by_batch: dict = {}
+    for e in chain:
+        if e["Type"] == "TransactionDebug" and e.get("Location") in order:
+            by_batch.setdefault(e["DebugID"], []).append(e)
+    assert by_batch
+    for did, evs in by_batch.items():
+        evs.sort(key=lambda e: e["Time"])
+        ranks = [order[e["Location"]] for e in evs]
+        assert ranks == sorted(ranks), f"batch {did} out of order: {ranks}"
+    # Every attach edge points a client txn ID at a batch that emitted
+    # a full downstream chain.
+    for a in attaches:
+        assert a["To"] in by_batch
+
+
+def test_flight_recorder_chain_bit_identical_same_seed():
+    c1 = json.dumps(_micro_chain(), sort_keys=True, default=str)
+    c2 = json.dumps(_micro_chain(), sort_keys=True, default=str)
+    assert c1 == c2
+
+
+def test_sample_rate_zero_emits_nothing_and_draws_nothing():
+    from foundationdb_tpu.workloads.tester import run_spec
+
+    spec = _spec()
+    spec["knobs"] = {}  # default COMMIT_SAMPLE_RATE = 0.0
+    res = run_spec(spec)
+    assert res["ok"]
+    assert global_sink().count("TransactionDebug") == 0
+    assert global_sink().count("TransactionAttach") == 0
+
+
+# ---------------------------------------------------------------------------
+# wire debug columns
+# ---------------------------------------------------------------------------
+
+def test_wirebatch_debug_column_roundtrip_and_slice():
+    import numpy as np
+
+    from foundationdb_tpu.kv.keys import KeyRange
+    from foundationdb_tpu.resolver.types import TxnConflictInfo
+    from foundationdb_tpu.resolver.wire import WireBatch
+
+    txns = [
+        TxnConflictInfo(7, [KeyRange(b"a%d" % i, b"b%d" % i)],
+                        [KeyRange(b"c%d" % i, b"d%d" % i)])
+        for i in range(6)
+    ]
+    dbg = ((1, "aaaa"), (4, "bbbb"))
+    wb = WireBatch.from_txns(txns, debug_ids=dbg)
+    rt = WireBatch.from_bytes(wb.to_bytes())
+    assert rt.dbg == dbg
+    assert np.array_equal(rt.snaps, wb.snaps)
+    # Unsampled batches add zero wire bytes for the column.
+    plain = WireBatch.from_txns(txns)
+    assert len(plain.to_bytes()) < len(wb.to_bytes())
+    assert WireBatch.from_bytes(plain.to_bytes()).dbg == ()
+    # Slicing rebases row indices and drops out-of-window ids.
+    s = rt.slice(1, 5)
+    assert s.dbg == ((0, "aaaa"), (3, "bbbb"))
+    assert rt.slice(2, 4).dbg == ()
+
+
+def test_commit_wire_debug_column_roundtrip():
+    from foundationdb_tpu.cluster.commit_wire import CommitWireBatch
+    from foundationdb_tpu.cluster.interfaces import (
+        CommitTransactionRequest,
+        Mutation,
+    )
+    from foundationdb_tpu.kv.atomic import MutationType
+
+    reqs = [
+        CommitTransactionRequest(
+            read_snapshot=i,
+            read_conflict_ranges=(),
+            write_conflict_ranges=(),
+            mutations=(Mutation(MutationType.SET_VALUE, b"k%d" % i, b"v"),),
+            debug_id=("id%d" % i) if i % 2 else None,
+        )
+        for i in range(4)
+    ]
+    wb = CommitWireBatch.from_reqs(reqs)
+    assert wb.dbg == ((1, "id1"), (3, "id3"))
+    out = CommitWireBatch.from_bytes(wb.to_bytes()).to_reqs()
+    assert [r.debug_id for r in out] == [None, "id1", None, "id3"]
+    assert [r.mutations[0].param1 for r in out] == \
+        [r.mutations[0].param1 for r in reqs]

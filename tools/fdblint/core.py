@@ -50,6 +50,7 @@ RULES: dict[str, str] = {
     "jax-tracer-concrete": "Python bool()/int()/if/while/.item() on a tracer inside a jitted function",
     "jax-host-sync": "host sync (np.asarray, .block_until_ready) inside a jitted function",
     "jax-pipeline-sync": "host sync (np.asarray, .block_until_ready) on an in-flight resolve handle outside the designated verdict-consumption sites",
+    "trace-unlogged": "TraceEvent constructed as a dropped expression (chain not ending in .log(), not a context manager, not returned) — a silently lost diagnostic",
     "knob-undeclared": "SERVER_KNOBS/CLIENT_KNOBS reference with no declaration in core/knobs.py",
     "knob-dead": "knob declared in core/knobs.py but referenced nowhere",
     "pragma": "malformed fdblint pragma (unknown rule id or missing '-- reason')",
@@ -251,7 +252,13 @@ def lint_paths(paths: Iterable[str], root: Optional[str] = None,
                baseline: Optional[dict[str, int]] = None) -> list[Finding]:
     """Run every rule pack over ``paths``; returns ALL findings with the
     suppression layers applied (callers filter on ``.suppressed``)."""
-    from . import rules_async, rules_determinism, rules_jax, rules_knobs
+    from . import (
+        rules_async,
+        rules_determinism,
+        rules_jax,
+        rules_knobs,
+        rules_trace,
+    )
 
     root = os.path.abspath(root or os.getcwd())
     ctxs = [c for c in (load_file(f, root) for f in collect_files(paths, root))
@@ -259,7 +266,8 @@ def lint_paths(paths: Iterable[str], root: Optional[str] = None,
     findings: list[Finding] = []
     for ctx in ctxs:
         findings.extend(ctx.pragma_findings)
-        for pack in (rules_determinism, rules_async, rules_jax):
+        for pack in (rules_determinism, rules_async, rules_jax,
+                     rules_trace):
             findings.extend(pack.check(ctx))
     findings.extend(rules_knobs.check_project(ctxs))
     findings.extend(rules_jax.check_project(ctxs))

@@ -1,0 +1,76 @@
+"""Rule pack — trace hygiene.
+
+``trace-unlogged``: a ``TraceEvent(...)`` built as an expression
+statement whose fluent chain does not end in ``.log()`` is a
+constructed-and-dropped diagnostic — the event object is discarded
+before anything emits it, so the evidence it was supposed to record
+silently never exists (the dynamic twin would be an unused-value
+warning, which Python does not have). Legitimate shapes are untouched:
+``with TraceEvent(...)`` (the context manager logs on exit),
+``return TraceEvent(...)`` (the caller owns it), and assignments
+(``ev = TraceEvent(...)`` ... ``ev.log()`` — the CounterCollection
+flush idiom; flow analysis over names is out of scope for a one-pass
+linter, and the dangerous shape in practice is the dropped chain).
+
+Scoped to ``foundationdb_tpu/`` like the determinism pack: test
+fixtures construct events deliberately.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import FileCtx, Finding
+
+_TRACE_CTORS = {"TraceEvent"}
+
+
+def _chain_parts(expr: ast.Call):
+    """For a fluent call chain ``Ctor(...).a(...).b(...)`` return
+    (ctor_call, outermost_method_name). ``expr`` is the OUTERMOST call;
+    a bare ``Ctor(...)`` returns (expr, None)."""
+    outer_method = None
+    node = expr
+    while isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+        if outer_method is None:
+            outer_method = node.func.attr
+        node = node.func.value
+    if isinstance(node, ast.Call):
+        return node, outer_method
+    return None, outer_method
+
+
+def _is_trace_ctor(ctx: FileCtx, call: ast.Call) -> bool:
+    name = ctx.resolve(call.func) or ctx.dotted(call.func) or ""
+    last = name.rsplit(".", 1)[-1]
+    return last in _TRACE_CTORS
+
+
+def check(ctx: FileCtx) -> list[Finding]:
+    if not ctx.path.startswith("foundationdb_tpu/"):
+        return []
+    findings: list[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Expr):
+            continue
+        value = node.value
+        # `await TraceEvent...` can't occur (sync API) but unwrap anyway.
+        if isinstance(value, ast.Await):
+            value = value.value
+        if not isinstance(value, ast.Call):
+            continue
+        ctor, outer_method = _chain_parts(value)
+        if ctor is None or not _is_trace_ctor(ctx, ctor):
+            continue
+        if outer_method == "log":
+            continue
+        what = (f"chain ends in .{outer_method}()" if outer_method
+                else "bare constructor")
+        findings.append(Finding(
+            ctx.path, value.lineno, "trace-unlogged",
+            f"TraceEvent constructed and dropped ({what}): the event is "
+            "never emitted — end the chain with .log(), use it as a "
+            "context manager, or return it",
+            end_line=getattr(value, "end_lineno", value.lineno),
+        ))
+    return findings

@@ -167,9 +167,28 @@ def main() -> int:
             spec = recruitment_spec(seed)
         else:
             spec = {**base, "seed": seed}
+        offending: list = []
         try:
             res = run_spec(spec)
-            ok = bool(res.get("ok")) and not res.get("sev_errors")
+            # SevError(40)+ gate with a per-spec allowlist: a spec that
+            # EXPECTS certain error-typed events (a nemesis designed to
+            # force them) names their Types in `sev_error_allowlist`;
+            # anything not listed fails the seed, and the offending
+            # events print in the repro block. Events beyond the capture
+            # cap count as offending — an uncaptured flood must not pass.
+            allow = set(spec.get("sev_error_allowlist", ()))
+            events = res.get("sev_error_events", [])
+            offending = [e for e in events
+                         if e.get("Type") not in allow]
+            uncaptured = res.get("sev_errors", 0) - len(events)
+            if uncaptured > 0 and allow:
+                offending.append({
+                    "Type": "<uncaptured>",
+                    "Count": uncaptured,
+                })
+            ok = bool(res.get("ok")) and (
+                not res.get("sev_errors") if not allow else not offending
+            )
             detail = ""
             if ok and args.check_determinism:
                 res2 = run_spec(spec)
@@ -185,6 +204,10 @@ def main() -> int:
             failures.append(seed)
             line += ("\n  error: " + str(res.get("error"))
                      if res.get("error") else "")
+            for e in offending[:10]:
+                line += "\n  sev-error event: " + json.dumps(
+                    e, sort_keys=True, default=str
+                )
             line += "\n  repro spec: " + json.dumps(spec, sort_keys=True,
                                                     default=str)
         print(line, flush=True)
