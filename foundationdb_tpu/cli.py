@@ -35,6 +35,12 @@ Commands (the fdbcli core surface):
     exclude [tag ...]             exclude storage servers (no args: list);
                                   data distribution drains them
     include <tag ...|all>         re-include excluded servers
+    move-machine <id>             drain one machine end-to-end: exclude
+                                  its storage (DD re-seeds the teams),
+                                  demote + re-replicate its logs onto a
+                                  recruited replacement, re-place the
+                                  txn bundle, then retire it role-free
+                                  (embedded --topology clusters)
     coordinators                  list the coordination quorum
     throttle <tps|off>            manual ratekeeper cap (fdbcli throttle)
     backup <url>                  snapshot into a container (fdbbackup)
@@ -70,11 +76,13 @@ def _p(raw: bytes) -> str:
 
 
 class Cli:
-    def __init__(self, sharded: bool = True, cluster_file: str = None):
+    def __init__(self, sharded: bool = True, cluster_file: str = None,
+                 topology: bool = False):
         self.cluster_file = cluster_file
         self.write_mode = False
         self._transport = None
         self._ctrl = None
+        self._ctrl_addr = None
         if cluster_file is not None:
             # ATTACH to a deployed multiprocess cluster: real transport,
             # client endpoints from the shared cluster file, and a
@@ -91,12 +99,34 @@ class Cli:
             self._ctrl = self._transport.remote_stream(
                 ctrl_addr, mp.WLTOKEN_CONTROLLER
             )
+            self._ctrl_addr = ctrl_addr
             self.cluster = None
             self.dd = None
             return
         self.loop = EventLoop()  # real clock: an interactive tool
         self._ctx = loop_context(self.loop)
         self._ctx.__enter__()
+        if topology:
+            # Machine-placed embedded cluster: the recoverable sharded
+            # tier over a machine fault topology, with a controller, the
+            # worker registry and data distribution running — what the
+            # machine-lifecycle verbs (`move-machine`, `recruitment`)
+            # operate on.
+            from .cluster.recovery import RecoverableShardedCluster
+            from .sim.topology import MachineTopology
+
+            topo_kw = {"n_dcs": 1, "machines_per_dc": 6}
+            self.cluster = RecoverableShardedCluster(
+                n_storage=6, n_logs=2, replication="double",
+                log_replication="double", shard_boundaries=[b"m"],
+                topology=topo_kw,
+            ).start()
+            topo = MachineTopology(self.cluster, **topo_kw)
+            self.cluster.sim_topology = topo
+            self.dd = self.cluster.start_data_distribution(interval=0.2)
+            self.cluster.start_controller("cli")
+            self.db = self.cluster.database()
+            return
         if sharded:
             # The management verbs (exclude/include + DD draining) need a
             # storage fleet; this is the fdbcli-against-a-real-cluster
@@ -131,8 +161,21 @@ class Cli:
 
     def _controller_rpc(self, req):
         """One request/reply against the controller endpoint (attached
-        mode only)."""
+        mode only). The controller address is re-resolved from the
+        cluster file per call: a controller FAILOVER re-points the
+        `controller` key at the new leaseholder, and the shell must
+        follow it to keep reading status/recruitment from the live
+        seat."""
+        from .cluster.multiprocess import WLTOKEN_CONTROLLER, read_cluster_file
         from .core.actors import timeout_error
+
+        info = read_cluster_file(self.cluster_file) or {}
+        addr = info.get("controller") or info.get("txn")
+        if addr and addr != self._ctrl_addr:
+            self._ctrl = self._transport.remote_stream(
+                addr, WLTOKEN_CONTROLLER
+            )
+            self._ctrl_addr = addr
 
         async def rpc():
             self._ctrl.send(req)
@@ -360,11 +403,19 @@ class Cli:
             for role, wid in sorted(rec.get("recruited", {}).items()):
                 lines.append(f"recruited {role} -> {wid}")
             stalls = rec.get("stalls", {})
+            details = rec.get("stall_details", {})
             if stalls:
                 for role, since in sorted(stalls.items()):
+                    d = details.get(role, {})
+                    awaiting = d.get("awaiting") or role
+                    cands = d.get("candidates")
+                    why = f"awaiting {awaiting}"
+                    if cands is not None:
+                        why += f", {cands} candidate(s)"
+                    if d.get("detail"):
+                        why += f" — {d['detail']}"
                     lines.append(
-                        f"STALL recruiting_{role} for {since}s "
-                        "(waiting for a candidate worker to register)"
+                        f"STALL recruiting_{role} for {since}s ({why})"
                     )
             else:
                 lines.append("No recruitment stalls.")
@@ -423,6 +474,26 @@ class Cli:
             self._run(management.exclude_servers(self.db, tags))
             return (f"Excluded {', '.join(map(str, tags))}; data "
                     "distribution will drain them (watch `status json`)")
+        if cmd == "move-machine":
+            if len(args) != 1:
+                return "usage: move-machine <machine-id>  (e.g. m0)"
+            self._need_write_mode()
+            if self.cluster is None or getattr(
+                self.cluster, "sim_topology", None
+            ) is None:
+                return ("move-machine needs a machine-placed cluster "
+                        "(run the shell with --topology; deployed "
+                        "clusters drain via exclude + machine kill.sh)")
+            from .cluster import management
+
+            s = self._run(
+                management.move_machine(self.db, self.cluster, args[0]),
+                timeout=180,
+            )
+            return (f"machine {s['machine']} drained and retired: "
+                    f"storage {s['excluded_storage']} excluded, "
+                    f"logs {s['demoted_logs']} demoted and "
+                    "re-replicated (watch `status json` machines)")
         if cmd == "include":
             self._need_write_mode()
             from .cluster import management
@@ -497,12 +568,18 @@ def main(argv=None) -> None:
                     help="attach to a DEPLOYED multiprocess cluster via "
                          "its shared cluster file instead of starting an "
                          "embedded one")
+    ap.add_argument("--topology", action="store_true",
+                    help="embedded mode: start a MACHINE-PLACED "
+                         "recoverable cluster (worker registry, "
+                         "controller, data distribution) so the machine "
+                         "lifecycle verbs — move-machine, recruitment — "
+                         "operate on real placement")
     ap.add_argument("command", nargs="*",
                     help="one-shot: run a single shell command (e.g. "
                          "`trace <debug-id>`, `events --severity 30`, "
                          "`status json`) and exit")
     args = ap.parse_args(argv)
-    cli = Cli(cluster_file=args.cluster_file)
+    cli = Cli(cluster_file=args.cluster_file, topology=args.topology)
     if args.command:
         # One-shot verb: scriptable operator path (the acceptance tests'
         # `cli.py trace <debug-id>` invocation shape).
@@ -515,6 +592,10 @@ def main(argv=None) -> None:
         return
     if args.cluster_file:
         print(f"fdbtpu-cli: attached to {args.cluster_file} (type help)")
+    elif args.topology:
+        print("fdbtpu-cli: machine-placed cluster started: 6 machines / "
+              "6 storage / double replication + double log replication "
+              "(type help)")
     else:
         print("fdbtpu-cli: sharded cluster started: 4 storage / double replication (type help)")
     try:

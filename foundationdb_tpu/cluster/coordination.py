@@ -143,6 +143,42 @@ class FileCoordinatorRegister(CoordinatorRegister):
         return ok
 
 
+class SharedFileCoordinatorRegister(FileCoordinatorRegister):
+    """A register server SHARED by several OS processes (multiple
+    controller candidates — txn hosts on different machines — arbitrating
+    one leader seat; ref: the coordinators being their own processes that
+    every candidate talks to). Each read/write re-loads the on-disk state
+    under an exclusive advisory lock and persists before releasing it, so
+    concurrent candidates observe a single linearizable register: a
+    promise one candidate's read installed can never be forgotten when
+    another candidate's write arrives. The generation protocol above
+    (CoordinatedState.read_modify_write) handles interleavings between
+    the two ops of a transition, exactly as it does for remote register
+    servers."""
+
+    def _locked(self):
+        import contextlib
+        import fcntl
+
+        @contextlib.contextmanager
+        def ctx():
+            with open(self.path + ".lock", "w") as lock:
+                fcntl.flock(lock, fcntl.LOCK_EX)
+                self.regs.clear()
+                self._load()
+                yield
+
+        return ctx()
+
+    def read(self, key: str, gen: int) -> tuple[Any, int]:
+        with self._locked():
+            return super().read(key, gen)
+
+    def write(self, key: str, gen: int, value: Any) -> bool:
+        with self._locked():
+            return super().write(key, gen, value)
+
+
 class CoordinatedState:
     """Client side of the quorum protocol for ONE keyed register (ref:
     CoordinatedState + ReusableCoordinatedState, masterserver.actor.cpp:78)."""
@@ -151,11 +187,20 @@ class CoordinatedState:
         self.coordinators = coordinators
         self.key = key
         self.quorum = len(coordinators) // 2 + 1
+        # Freshness floor: generations must beat every generation this
+        # client has OBSERVED, not just its own clock. Two candidate
+        # processes share no clock origin (RealClock is process-relative),
+        # so a late-started candidate learns the incumbent's generation
+        # height from read replies (and from failed writes, exponentially)
+        # instead of never catching up to it.
+        self._gen_floor = 0
 
     def _fresh_gen(self) -> int:
-        # Monotone, collision-avoiding generation: sim-time tick + entropy.
+        # Monotone, collision-avoiding generation: sim-time tick + entropy,
+        # floored by the highest generation observed from the registers.
         loop = current_loop()
-        return int(loop.now() * 1_000_000) * 64 + loop.random.random_int(0, 64)
+        base = int(loop.now() * 1_000_000) * 64 + loop.random.random_int(0, 64)
+        return max(base, self._gen_floor)
 
     def read(self, gen: int) -> Any:
         """Quorum read at `gen`; returns the value with the highest write
@@ -171,6 +216,7 @@ class CoordinatedState:
                 best, best_gen = value, wgen
         if ok < self.quorum:
             raise OperationFailed("coordination quorum unavailable for read")
+        self._gen_floor = max(self._gen_floor, best_gen + 1)
         return best
 
     def write(self, gen: int, value: Any) -> bool:
@@ -196,7 +242,12 @@ class CoordinatedState:
             new = update(current)
             if self.write(gen, new):
                 return gen, new
-            # Raced by a newer generation; re-read and try again.
+            # Raced by a newer generation (or an orphaned read promise a
+            # dead candidate left above every write): re-read with a
+            # strictly higher floor so convergence is logarithmic, never
+            # a livelock against a promise no reply will ever name.
+            self._gen_floor = max(self._gen_floor * 2,
+                                  self._gen_floor + 64, gen + 1)
 
 
 @dataclass

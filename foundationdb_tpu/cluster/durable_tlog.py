@@ -395,6 +395,31 @@ class DurableTaggedTLog(TaggedTLog):
         if self._spill_hi > version:
             self._spill_hi = version if version > 0 else None
 
+    def seed_rebuilt_state(self, entries: list, version: int,
+                           popped_by_tag: dict | None = None) -> None:
+        """Durable seed of a recruited replacement log: the re-replicated
+        tail is pushed through the DiskQueue and fsynced BEFORE the
+        cursors advance — a post-seed power loss must replay the same
+        tail, or the epoch-end quorum would count a durable cursor the
+        disk cannot back."""
+        super().seed_rebuilt_state(entries, version,
+                                   popped_by_tag=popped_by_tag)
+        prev = 0
+        for v, tms in self._entries:
+            blob = _enc_entry(prev, v, tms)
+            seq = self._push_blob(_K_ENTRY, blob)
+            self._entry_seq.append((v, seq))
+            self._entry_bytes[v] = len(blob)
+            self._mem_bytes += len(blob)
+            prev = v
+        for tag, floor in sorted((popped_by_tag or {}).items()):
+            w = BinaryWriter()
+            w.u32(tag).u64(floor)
+            self._push_blob(_K_POP, w.to_bytes())
+        self.queue.commit()  # the seed's fsync
+        self.entry_durable = max(self.entry_durable, version)
+        self._maybe_spill()
+
     # -- fences (both made durable) --
     def lock(self, epoch: int) -> int:
         d = super().lock(epoch)

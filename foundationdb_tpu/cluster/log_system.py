@@ -174,6 +174,33 @@ class TaggedTLog(MemoryTLog):
         if self._popped_by_tag:
             self.pop(min(self._popped_by_tag.values()))
 
+    def seed_rebuilt_state(self, entries: list, version: int,
+                           popped_by_tag: Optional[dict] = None) -> None:
+        """Initialize a REPLACEMENT log from its peers' re-replicated
+        tail (log re-recruitment: a recruited log takes over a dead
+        replica's slot and must hold every un-popped version destined to
+        it before the next epoch end counts its durable cursor).
+        `entries` is the version-sorted (version, [TaggedMutation]) tail;
+        `version` the donors' durable top this copy is complete through —
+        the seeded cursor, so the epoch-end quorum and truncate_above see
+        an honest, non-gapped replica (a top below the recovery version
+        would mark this whole copy unavailable). The durable tier
+        overrides this to persist the seed before advancing cursors."""
+        assert not self._entries, "seed into a fresh log only"
+        self._entries = list(entries)
+        if self._entries and self._entries[-1][0] < version:
+            # Top-off: an empty entry at the donors' durable top keeps
+            # truncate_above's gap detection honest (top >= any recovery
+            # version the quorum can pick, so the seeded tail stays
+            # servable). Consumers advance through empty versions anyway.
+            self._entries.append((version, []))
+        for tag, floor in sorted((popped_by_tag or {}).items()):
+            self._popped_by_tag[tag] = floor
+        if version > self.version.get():
+            self.version.set(version)
+        if version > self.durable.get():
+            self.durable.set(version)
+
 
 class TagPartitionedLogSystem:
     def __init__(self, n_logs: int = 1, init_version: int = 0,
@@ -287,6 +314,122 @@ class TagPartitionedLogSystem:
             for log_set in self.log_sets:
                 for i in self.replica_set_for_tag(tag):
                     log_set[i]._popped_by_tag.setdefault(tag, 0)
+
+    # -- log re-recruitment (ref: the reference recruiting a fresh tlog
+    #    onto any TransactionClass worker at epoch end and re-replicating
+    #    from the surviving quorum; here the recruited log takes over the
+    #    dead replica's SLOT so tag routing — a pure function of the spec
+    #    — never changes) --
+    def rebuild_log(self, index: int, fresh: TaggedTLog) -> TaggedTLog:
+        """Replace serving log `index` with `fresh`, re-replicating the
+        surviving replicas' durable, un-popped tail of every version
+        destined to this slot. Correctness rides the k-way push quorum:
+        every acked version destined to slot `index` via tag t is durable
+        on every live replica of t, so the union over reachable peers is
+        complete — per tag — above that tag's pop floor (below it the
+        slice was applied by storage and discarded everywhere). A tag
+        whose replica set has NO reachable donor (single log replication,
+        or loss beyond budget) loses its un-shipped window: that is a
+        SevError — re-recruitment under an insufficient mode cannot
+        invent the lost copy (the destroyed-datadir contract).
+
+        Returns the retired log object (dark or draining); the caller
+        owns its teardown and the machine/host bookkeeping."""
+        serving = self.log_sets[self.active_set]
+        old = serving[index]
+        donors = [log for log in serving
+                  if log is not old and getattr(log, "reachable", True)]
+        # Tags destined to this slot, and whether each has a live donor.
+        slot_tags = sorted(
+            t for t in self._registered_tags
+            if index in self.replica_set_for_tag(t)
+        )
+        uncovered = [
+            t for t in slot_tags
+            if not any(serving[i] is not old
+                       and getattr(serving[i], "reachable", True)
+                       for i in self.replica_set_for_tag(t)
+                       if i < len(serving))
+        ]
+        if uncovered and getattr(old, "reachable", True):
+            # Draining a LIVE log (machine drain): the retiring copy is
+            # itself the donor of last resort — zero loss at any mode.
+            donors = [old] + donors
+            uncovered = []
+        if uncovered:
+            TraceEvent("LogReplacementWindowLost", severity=40).detail(
+                "Log", index
+            ).detail("Tags", ",".join(map(str, uncovered))).detail(
+                "Mode", self.log_replication
+            ).log()
+        # Union of the donors' durable entries destined to this slot.
+        # Dedupe by VALUE with per-donor multiplicity: identical-value
+        # mutations share tag vectors, hence replica sets, hence donors —
+        # any one donor holding a value holds its full multiplicity, so
+        # max-over-donors is the exact count (id()-dedupe would break on
+        # the durable tier, where replay re-materializes objects).
+        per_version: dict[int, dict] = {}
+        d_top = 0
+        for donor in donors:
+            d = donor.durable.get()
+            d_top = max(d_top, d)
+            for v, tms in donor._entries:
+                if v > d:
+                    continue
+                counts: dict = {}
+                for tm in tms:
+                    if not any(index in self.replica_set_for_tag(t)
+                               for t in tm.tags):
+                        continue
+                    key = (tm.tags, tm.mutation.type,
+                           tm.mutation.param1, tm.mutation.param2)
+                    c, _ = counts.get(key, (0, tm))
+                    counts[key] = (c + 1, tm)
+                if not counts:
+                    continue
+                merged = per_version.setdefault(v, {})
+                for key, (c, tm) in counts.items():
+                    have = merged.get(key)
+                    if have is None or have[0] < c:
+                        merged[key] = (c, tm)
+        entries = []
+        for v in sorted(per_version):
+            tms: list = []
+            # Entry order within a version follows the donor batch scan —
+            # per-key insertion order of the merged dict, which is the
+            # deterministic serving-set donor order, never hash order.
+            for _key, (c, tm) in per_version[v].items():
+                tms.extend([tm] * c)
+            entries.append((v, tms))
+        # Per-tag pop floors: the most conservative (minimum) floor any
+        # replica of the tag still records, so the fresh copy never
+        # discards a slice a slow consumer still needs.
+        floors: dict[int, int] = {}
+        for t in slot_tags:
+            vals = [
+                donor._popped_by_tag[t] for donor in donors
+                if t in donor._popped_by_tag
+            ]
+            floors[t] = min(vals) if vals else 0
+        fresh.seed_rebuilt_state(entries, d_top, popped_by_tag=floors)
+        serving[index] = fresh
+        self.reregister_tags()
+        # Wake every tag cursor parked inside the RETIRED copy's peek:
+        # its durable cursor will never advance, so the parked peek must
+        # re-resolve onto the serving set (the same signal a region
+        # failover fires — any serving-set change re-arms it).
+        from ..core.runtime import Future
+
+        fut, self._failover_fut = self._failover_fut, Future()
+        fut._send(None)
+        TraceEvent("LogReplicaRebuilt", severity=20).detail(
+            "Log", index
+        ).detail("Entries", len(entries)).detail(
+            "SeedVersion", d_top
+        ).detail("Donors", len(donors)).detail(
+            "TagsUncovered", len(uncovered)
+        ).log()
+        return old
 
     # -- the commit path (ref: push :339) --
     async def push(self, prev_version: int, version: int,

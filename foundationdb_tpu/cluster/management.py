@@ -56,6 +56,118 @@ async def get_excluded_servers(db) -> set[int]:
     return await db.transact(body)
 
 
+async def move_machine(db, cluster, machine_id: str,
+                       timeout_s: float = 120.0) -> dict:
+    """Drain one machine end-to-end and retire it (ref: the fdbcli
+    exclude-then-remove operator flow, generalized to every role a
+    machine hosts — the `moveMachine` verb the ROADMAP's self-healing
+    item owed):
+
+      1. EXCLUDE its storage replicas (ordinary \\xff writes): data
+         distribution re-seeds every team off them through move_keys —
+         the excluded servers stay live and donate during the drain.
+      2. DEMOTE its logs: mark the machine draining and force a
+         recovery; the recovery hook re-recruits each log slot onto a
+         ranked replacement machine and re-replicates the tail with the
+         RETIRING copy itself as a donor (zero acked-write loss at any
+         log replication mode — this is what distinguishes a drain from
+         a death).
+      3. Re-place the transaction bundle if it lives here (the ordinary
+         recovery ranker, which now skips the draining machine).
+      4. RETIRE: role-free, forgotten by the registry, never placed or
+         restored again.
+
+    Returns a summary dict. Needs the machine fault topology
+    (cluster.sim_topology) and, when the machine hosts storage, a
+    running data distributor."""
+    from ..core.errors import OperationFailed
+    from ..core.runtime import current_loop
+    from ..core.trace import TraceEvent
+
+    topo = getattr(cluster, "sim_topology", None)
+    if topo is None:
+        raise OperationFailed(
+            "move_machine needs the machine fault topology "
+            "(cluster.sim_topology)"
+        )
+    m = next((mm for mm in topo.machines if mm.name == machine_id), None)
+    if m is None:
+        raise OperationFailed(
+            f"unknown machine {machine_id!r} "
+            f"(have: {[mm.name for mm in topo.machines]})"
+        )
+    if m.protected:
+        raise OperationFailed(
+            f"machine {machine_id} hosts coordinators; move the "
+            "coordination quorum first"
+        )
+    if not m.alive or m.retired:
+        raise OperationFailed(f"machine {machine_id} is not live")
+    loop = current_loop()
+    deadline = loop.now() + timeout_s
+    summary = {"machine": machine_id,
+               "excluded_storage": sorted(m.storage_tags),
+               "demoted_logs": sorted(m.log_ids)}
+    m.draining = True
+    try:
+        # -- 1. storage: exclude + wait for DD to re-seed every team --
+        if m.storage_tags:
+            if getattr(cluster, "dd", None) is None:
+                raise OperationFailed(
+                    "machine hosts storage but data distribution is not "
+                    "running (start_data_distribution first)"
+                )
+            await exclude_servers(db, sorted(m.storage_tags))
+            while loop.now() < deadline:
+                held = {t for t in m.storage_tags
+                        if any(t in team
+                               for team in cluster.shard_map.teams())}
+                if not held:
+                    break
+                await loop.delay(0.25)
+            else:
+                raise OperationFailed(
+                    f"storage drain of {machine_id} did not finish "
+                    f"within {timeout_s}s (teams still reference "
+                    f"{sorted(held)})"
+                )
+            # Decommission the drained replicas: excluded, team-free and
+            # data-free — the machine no longer hosts them (the reference
+            # removes excluded storage processes the same way; the
+            # standing exclusion keeps DD from ever re-teaming the tags).
+            for t in sorted(m.storage_tags):
+                cluster.storages[t].stop()
+            m.storage_tags.clear()
+        # -- 2 + 3. logs + txn bundle: one forced recovery re-recruits
+        #    both (the hook replaces draining-machine logs with the live
+        #    copy as donor; the ranker skips draining machines) --
+        if m.log_ids or m.has_txn:
+            cluster.kill_transaction_system()
+            while loop.now() < deadline:
+                try:
+                    cluster._recover()
+                except BaseException as e:  # noqa: BLE001 — stalled
+                    TraceEvent("MoveMachineRecoveryRetry",
+                               severity=20).error(e).log()
+                if not m.log_ids and not m.has_txn \
+                        and cluster.proxy is not None:
+                    break
+                await loop.delay(0.5)
+            else:
+                raise OperationFailed(
+                    f"log/txn demotion of {machine_id} did not finish "
+                    f"within {timeout_s}s"
+                )
+    finally:
+        m.draining = False
+    topo.retire_machine(m)
+    summary["retired"] = True
+    TraceEvent("MachineMoved").detail("Machine", machine_id).detail(
+        "Storage", len(summary["excluded_storage"])
+    ).detail("Logs", len(summary["demoted_logs"])).log()
+    return summary
+
+
 async def configure(db, **settings) -> None:
     """Set replicated configuration values, e.g.
     configure(db, redundancy_mode="triple", logs=4) (ref: changeConfig,

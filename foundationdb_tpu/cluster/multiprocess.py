@@ -401,6 +401,24 @@ def is_resolver_class(role_class: str) -> bool:
     )
 
 
+def txn_host_classes(n_txn_hosts: int) -> list[str]:
+    """Process-class names of the CONTROLLER CANDIDATES (txn hosts).
+    Every candidate runs coordination + the controller election over the
+    spec's shared `coordination_dir`; the leaseholder recruits and serves
+    the transaction system, the others stand by — losing the incumbent's
+    machine moves the seat, and the worker registry is rebuilt from
+    re-registrations against the new `controller` address."""
+    if n_txn_hosts <= 1:
+        return ["txn"]
+    return [f"txn{j}" for j in range(n_txn_hosts)]
+
+
+def is_txn_class(role_class: str) -> bool:
+    return role_class == "txn" or (
+        role_class.startswith("txn") and role_class[3:].isdigit()
+    )
+
+
 def machine_for_class(spec: dict, role_class: str) -> str:
     """The failure-domain id of a role class: the spec's `machines`
     stanza ({machine_id: [class, ...]}) when present, else the class is
@@ -547,6 +565,60 @@ class LogHost:
 # ---------------------------------------------------------------------------
 # storage host
 # ---------------------------------------------------------------------------
+class LogAddressBook:
+    """The storage host's CURRENT view of the log hosts' addresses.
+    Log re-recruitment can re-point a class at a spare on a different
+    address (the spare publishes its class key at boot; the controller
+    re-publishes after recruiting it): consumers resolve every stream
+    through the book, and a background refresher follows the shared
+    cluster file — the same document the re-pointing was published to —
+    so replicated tag cursors fail over onto the recruited host without
+    a storage restart. Streams are cached per (address, token); the
+    steady state is one dict lookup."""
+
+    def __init__(self, transport, log_addrs: list[str],
+                 cluster_file: Optional[str] = None):
+        self.transport = transport
+        self.addrs = list(log_addrs)
+        self.cluster_file = cluster_file
+        self._cache: dict = {}
+
+    def stream(self, host: int, token: int):
+        key = (self.addrs[host], token)
+        s = self._cache.get(key)
+        if s is None:
+            s = self._cache[key] = self.transport.remote_stream(*key)
+        return s
+
+    def refresh(self) -> bool:
+        if not self.cluster_file:
+            return False
+        info = read_cluster_file(self.cluster_file) or {}
+        changed = False
+        for j, cls in enumerate(log_host_classes(len(self.addrs))):
+            addr = info.get(cls)
+            if addr and addr != self.addrs[j]:
+                TraceEvent("LogAddressRepointed").detail(
+                    "Class", cls
+                ).detail("From", self.addrs[j]).detail("To", addr).log()
+                self.addrs[j] = addr
+                changed = True
+        return changed
+
+    def start_refresher(self, tasks: ActorCollection) -> None:
+        async def refresher():
+            loop = current_loop()
+            while True:
+                await loop.delay(SERVER_KNOBS.WORKER_HEARTBEAT_INTERVAL)
+                try:
+                    self.refresh()
+                except BaseException:  # noqa: BLE001 — mid-replace read
+                    pass
+
+        tasks.add(spawn(refresher(), TaskPriority.DEFAULT,
+                        name="logAddrRefresh"))
+
+
 class DurabilityTracker:
     """System flush horizon across N log hosts: latest known per-host
     entry-durable floor, combined with min. Every cached value is a true
@@ -555,14 +627,13 @@ class DurabilityTracker:
     un-writes them. Peek replies feed the owning host's slot for free; a
     background poller covers hosts this storage holds no tags on."""
 
-    def __init__(self, transport, log_addrs: list[str]):
-        self.n_hosts = len(log_addrs)
+    def __init__(self, transport, log_addrs, book: Optional[LogAddressBook]
+                 = None):
+        if book is None:
+            book = LogAddressBook(transport, log_addrs)
+        self.book = book
+        self.n_hosts = len(book.addrs)
         self._floor = [0] * self.n_hosts
-        # One control stream per host (its lowest-id owned log).
-        self._ctrl = [
-            transport.remote_stream(addr, WLTOKEN_LOG_BASE + 2 * j + 1)
-            for j, addr in enumerate(log_addrs)
-        ]
 
     def feed(self, host: int, value: int) -> None:
         self._floor[host] = max(self._floor[host], value)
@@ -574,9 +645,14 @@ class DurabilityTracker:
         async def poll():
             loop = current_loop()
             while True:
-                for j, ctrl in enumerate(self._ctrl):
+                for j in range(self.n_hosts):
                     req = TLogHostDurableRequest()
-                    ctrl.send(req)
+                    # Host j's lowest-id owned log is log j (round-robin
+                    # ownership), resolved through the address book so a
+                    # recruited replacement host is followed live.
+                    self.book.stream(
+                        j, WLTOKEN_LOG_BASE + 2 * j + 1
+                    ).send(req)
                     got = await timeout(
                         req.reply.future, SERVER_KNOBS.ROLE_RPC_TIMEOUT,
                         _LOST,
@@ -605,26 +681,37 @@ class RemoteTagView:
     budget (or popped) and the cursor jumps the gap via the least-gapped
     replica (log_system.TagView's contract, over the wire)."""
 
-    def __init__(self, transport, log_addrs: list[str], tag: int,
+    def __init__(self, transport, log_addrs, tag: int,
                  n_logs: int, tracker: DurabilityTracker,
-                 log_replication: str = "single", topology=None):
+                 log_replication: str = "single", topology=None,
+                 book: Optional[LogAddressBook] = None):
         from .log_system import log_replicas, replica_set_for_tag
         from .replication import policy_for_mode
 
         self.tag = tag
+        if book is None:
+            book = LogAddressBook(transport, log_addrs)
+        self.book = book
         policy = policy_for_mode(log_replication)
         self._replica_ids = replica_set_for_tag(
             tag % n_logs, log_replicas(n_logs, topology), policy
         )
-        self._hosts = [log_owner(i, len(log_addrs))
+        self._hosts = [log_owner(i, len(book.addrs))
                        for i in self._replica_ids]
-        self._ctrls = [
-            transport.remote_stream(log_addrs[h],
-                                    WLTOKEN_LOG_BASE + 2 * i + 1)
-            for i, h in zip(self._replica_ids, self._hosts)
-        ]
         self._pref = 0  # serving replica (index into the replica set)
         self._tracker = tracker
+
+    def _ctrl(self, k: int):
+        # Resolved through the address book per send: a recruited
+        # replacement log host is followed the moment its class key
+        # re-points, with no storage restart.
+        return self.book.stream(
+            self._hosts[k], WLTOKEN_LOG_BASE + 2 * self._replica_ids[k] + 1
+        )
+
+    @property
+    def _ctrls(self) -> list:
+        return [self._ctrl(k) for k in range(len(self._replica_ids))]
 
     async def peek(self, from_version: int):
         loop = current_loop()
@@ -632,7 +719,7 @@ class RemoteTagView:
         while True:
             k = self._pref
             req = TLogPeekRequest(self.tag, from_version)
-            self._ctrls[k].send(req)
+            self._ctrl(k).send(req)
             try:
                 entries, durable_all, available_from = await req.reply.future
             except BaseException:  # noqa: BLE001 — conn loss: the host may
@@ -672,7 +759,8 @@ class RemoteTagView:
 
 
 class StorageHost:
-    def __init__(self, transport, datadir: str, spec: dict, log_addrs):
+    def __init__(self, transport, datadir: str, spec: dict, log_addrs,
+                 cluster_file: Optional[str] = None):
         from .sharded_cluster import (
             _all_false_map,
             _make_engine,
@@ -689,13 +777,21 @@ class StorageHost:
                                topology=kw["topology"])
         self.storages = []
         self._tasks = ActorCollection()
-        self.durability = DurabilityTracker(transport, log_addrs)
+        # ONE address book shared by the tracker and every tag cursor:
+        # log re-recruitment re-points a class key in the cluster file
+        # and the refresher follows it live.
+        self.log_book = LogAddressBook(transport, log_addrs,
+                                       cluster_file=cluster_file)
+        self.log_book.start_refresher(self._tasks)
+        self.durability = DurabilityTracker(transport, log_addrs,
+                                            book=self.log_book)
         self.durability.start_polling(self._tasks)
         for tag in range(kw["n_storage"]):
             view = RemoteTagView(transport, log_addrs, tag, kw["n_logs"],
                                  self.durability,
                                  log_replication=kw["log_replication"],
-                                 topology=kw["topology"])
+                                 topology=kw["topology"],
+                                 book=self.log_book)
             eng = _make_engine(spec.get("engine", "memory"),
                                f"{datadir}/storage{tag}")
             s = StorageServer(view, 0, tag=tag, engine=eng)
@@ -1085,7 +1181,8 @@ class TxnHost:
 
     def __init__(self, transport, datadir: Optional[str], spec: dict,
                  log_addrs, storage_addr: str, resolver_addr=None,
-                 want_resolvers: Optional[bool] = None):
+                 want_resolvers: Optional[bool] = None,
+                 cluster_file: Optional[str] = None):
         from .coordination import (
             CoordinatedState,
             CoordinatorRegister,
@@ -1098,7 +1195,9 @@ class TxnHost:
         from .shards import ShardMap
 
         self.transport = transport
+        self.cluster_file = cluster_file
         kw = _spec_kw(spec)
+        self._kw = kw
         self.n_logs = kw["n_logs"]
         self.n_storage = kw["n_storage"]
         self.n_resolvers = kw["n_resolvers"]
@@ -1132,29 +1231,37 @@ class TxnHost:
                 f"resolver@{resolver_addr}", process_class="resolver",
                 address=resolver_addr, pinned=True,
             )
+        self.log_addrs = ([log_addrs] if isinstance(log_addrs, str)
+                          else list(log_addrs))
+        self.storage_addr = storage_addr
         self.log_system = RemoteLogSystem(
-            transport, log_addrs, self.n_logs,
+            transport, list(self.log_addrs), self.n_logs,
             log_replication=kw["log_replication"], topology=kw["topology"],
         )
-        self.storage_ctrl = {
-            tag: transport.remote_stream(
-                storage_addr, WLTOKEN_STORAGE_BASE + 2 * tag + 1
-            )
-            for tag in range(self.n_storage)
-        }
-        self.storage_reads = {
-            tag: transport.remote_stream(
-                storage_addr, WLTOKEN_STORAGE_BASE + 2 * tag
-            )
-            for tag in range(self.n_storage)
-        }
+        self._bind_storage_streams()
         self.shard_map = ShardMap(default_team=())
         for lo, hi, team in derive_layout(
             self.n_storage, kw["replication"], kw["shard_boundaries"],
             kw["seed"], topology=kw["topology"],
         ):
             self.shard_map.set_team(KeyRange(lo, hi), team)
-        if datadir is not None:
+        coordination_dir = spec.get("coordination_dir")
+        if coordination_dir:
+            # Multi-candidate controller failover: every txn host shares
+            # ONE coordination quorum through flock-serialized on-disk
+            # registers, so the leader seat (and the generation fence)
+            # survives the incumbent machine's death.
+            from .coordination import SharedFileCoordinatorRegister
+
+            os.makedirs(coordination_dir, exist_ok=True)
+            self.coordinators = [
+                SharedFileCoordinatorRegister(
+                    f"coord{i}",
+                    os.path.join(coordination_dir, f"coord{i}.json"),
+                )
+                for i in range(3)
+            ]
+        elif datadir is not None:
             os.makedirs(datadir, exist_ok=True)
             self.coordinators = [
                 FileCoordinatorRegister(f"coord{i}",
@@ -1338,6 +1445,166 @@ class TxnHost:
         st["recovery_state"] = self.recovery_state
         return st
 
+    def _bind_storage_streams(self) -> None:
+        self.storage_ctrl = {
+            tag: self.transport.remote_stream(
+                self.storage_addr, WLTOKEN_STORAGE_BASE + 2 * tag + 1
+            )
+            for tag in range(self.n_storage)
+        }
+        self.storage_reads = {
+            tag: self.transport.remote_stream(
+                self.storage_addr, WLTOKEN_STORAGE_BASE + 2 * tag
+            )
+            for tag in range(self.n_storage)
+        }
+
+    # -- durable-role re-recruitment (log + storage hosts) --
+    def _lowest_owned_log(self, host_idx: int) -> int:
+        return min(i for i in range(self.n_logs)
+                   if log_owner(i, len(self.log_addrs)) == host_idx)
+
+    async def _probe_log_host(self, addr: str, host_idx: int) -> bool:
+        """One durability-floor RPC against a log host: answers iff the
+        host is live and serving its logs (the recruitment confirm)."""
+        req = TLogHostDurableRequest()
+        self.transport.remote_stream(
+            addr, WLTOKEN_LOG_BASE + 2 * self._lowest_owned_log(host_idx) + 1
+        ).send(req)
+        got = await timeout(req.reply.future,
+                            SERVER_KNOBS.ROLE_RPC_TIMEOUT, _LOST)
+        return got is not _LOST
+
+    async def _recruit_log_hosts(self, detail: str) -> bool:
+        """Convert an unreachable-log-quorum lock failure into
+        RE-RECRUITMENT: probe every log host, and for each dead one rank
+        the live registered spares of the SAME class (the spare serves
+        the same global log ids from its own — empty — datadir; the
+        epoch-end quorum excludes its zeroed cursors within the
+        replication budget and the replicated tag cursors fail over to
+        the surviving copies, PR 6's machinery, so the tail re-replicates
+        forward). Returns True when any host was re-pointed (the caller
+        retries the lock); raises RecruitmentStalled when a dead host has
+        no live spare — the recovery parks in recruiting_log and the
+        status json names the awaited class."""
+        from .recruitment import Fitness, RecruitmentStalled, select_workers
+
+        classes = log_host_classes(len(self.log_addrs))
+        dead = [j for j in range(len(self.log_addrs))
+                if not await self._probe_log_host(self.log_addrs[j], j)]
+        if not dead:
+            return False
+        replaced = False
+        for j in dead:
+            cls = classes[j]
+            cands = [w for w in self.registry.live_workers()
+                     if w.process_class == cls and w.address]
+            got = select_workers(cands, "log", 1, max_fitness=Fitness.BEST)
+            if not got:
+                self.recovery_state = "recruiting_log"
+                self.registry.note_stall(
+                    "log", awaiting=cls, candidates=0,
+                    detail=f"log host {cls}@{self.log_addrs[j]} "
+                           f"unreachable; no live spare ({detail})",
+                )
+                raise RecruitmentStalled(
+                    "log", f"log host {cls} dead; no spare registered"
+                )
+            w = got[0]
+            if not await self._probe_log_host(w.address, j):
+                # Lease said live but the spare is gone (mid-SIGKILL):
+                # forget it so the next attempt ranks the survivors —
+                # it must NOT be re-selected before re-registering.
+                self.registry.forget(w.worker_id)
+                raise OperationFailed(
+                    f"log spare {w.worker_id} did not confirm recruitment"
+                )
+            self.log_addrs[j] = w.address
+            self.recruited[cls] = w.worker_id
+            replaced = True
+            TraceEvent("LogHostRecruited").detail("Class", cls).detail(
+                "Worker", w.worker_id
+            ).detail("Address", w.address).log()
+        if replaced:
+            self.log_system = RemoteLogSystem(
+                self.transport, list(self.log_addrs), self.n_logs,
+                log_replication=self._kw["log_replication"],
+                topology=self._kw["topology"],
+            )
+            if self.cluster_file:
+                # Publish the re-pointed addresses so storage hosts'
+                # cursors re-resolve off the shared document too.
+                write_cluster_file(self.cluster_file, {
+                    classes[j]: self.log_addrs[j] for j in dead
+                })
+            self.registry.note_resumed("log")
+        return replaced
+
+    async def _rollback_one(self, tag: int, recovery_version: int) -> bool:
+        """Rollback confirm with knob-configured backoff between the
+        attempts (STORAGE_ROLLBACK_RETRY_DELAY, sim-randomized): three
+        back-to-back sends used to hot-loop against a dead host."""
+        loop = current_loop()
+        for attempt in range(3):
+            if attempt:
+                await loop.delay(
+                    SERVER_KNOBS.STORAGE_ROLLBACK_RETRY_DELAY
+                    * (0.5 + loop.random.random01())
+                )
+            req = StorageRollbackRequest(recovery_version)
+            self.storage_ctrl[tag].send(req)
+            got = await timeout(
+                req.reply.future, SERVER_KNOBS.ROLE_RPC_TIMEOUT, _LOST
+            )
+            if got is not _LOST:
+                return True
+        return False
+
+    async def _recruit_storage_host(self, tag: int) -> None:
+        """Re-point the storage fleet's endpoints at a live registered
+        spare of class `storage` (the unreachable-rollback park converted
+        into recruitment). The spare starts from its own datadir and
+        re-pulls the logs' retained windows; raises RecruitmentStalled
+        when no spare exists — the recovery parks in recruiting_storage
+        with the awaited class and candidate count in status json."""
+        from .recruitment import Fitness, RecruitmentStalled, select_workers
+
+        cands = [w for w in self.registry.live_workers()
+                 if w.process_class == "storage" and w.address]
+        got = select_workers(cands, "storage", 1, max_fitness=Fitness.BEST)
+        if not got:
+            self.recovery_state = "recruiting_storage"
+            self.registry.note_stall(
+                "storage", awaiting="storage", candidates=0,
+                detail=f"storage {tag} unreachable; no live spare",
+            )
+            raise RecruitmentStalled(
+                "storage", f"storage {tag} unreachable; no spare registered"
+            )
+        w = got[0]
+        probe = StorageStatusRequest()
+        self.transport.remote_stream(
+            w.address, WLTOKEN_STORAGE_BASE + 2 * tag + 1
+        ).send(probe)
+        confirmed = await timeout(probe.reply.future,
+                                  SERVER_KNOBS.ROLE_RPC_TIMEOUT, _LOST)
+        if confirmed is _LOST:
+            self.registry.forget(w.worker_id)
+            raise OperationFailed(
+                f"storage spare {w.worker_id} did not confirm recruitment"
+            )
+        if w.address != self.storage_addr:
+            self.storage_addr = w.address
+            self._bind_storage_streams()
+            if self.cluster_file:
+                write_cluster_file(self.cluster_file,
+                                   {"storage": w.address})
+        self.recruited["storage"] = w.worker_id
+        self.registry.note_resumed("storage")
+        TraceEvent("StorageHostRecruited").detail(
+            "Worker", w.worker_id
+        ).detail("Address", w.address).log()
+
     # -- read forwarding (by-key routing like the client's location cache) --
     async def _forward_read(self, req):
         if isinstance(req, GetValueRequest):
@@ -1404,37 +1671,44 @@ class TxnHost:
 
         self.recovery_state = "locking_logs"
         generation = _bump_generation(self.cstate)
-        try:
-            recovery_version, received = await self.log_system.lock(
-                generation
-            )
-        except OperationFailed as e:
-            # A log host beyond the replication budget is unreachable.
-            # Park as a NAMED stall (status json shows recruiting_log)
-            # and resume the instant a log worker (re)registers — never
-            # a hot crash loop against a dead quorum.
-            self.recovery_state = "recruiting_log"
-            self.registry.note_stall("log", detail=str(e))
-            raise RecruitmentStalled("log", str(e)) from e
+        for lock_attempt in range(4):
+            try:
+                recovery_version, received = await self.log_system.lock(
+                    generation
+                )
+                break
+            except OperationFailed as e:
+                # A log host beyond the replication budget is
+                # unreachable. RE-RECRUIT: a live registered spare of the
+                # dead class takes over its logs (fresh datadir; the
+                # epoch-end truncate + replicated-cursor failover
+                # re-replicates the surviving tail onto it) and the lock
+                # retries. Only when no spare exists — or the failure is
+                # not a dead host at all — does the recovery park as a
+                # NAMED stall (status json shows recruiting_log), resumed
+                # the instant a log worker (re)registers; never a hot
+                # crash loop against a dead quorum.
+                if lock_attempt == 3 \
+                        or not await self._recruit_log_hosts(str(e)):
+                    self.recovery_state = "recruiting_log"
+                    self.registry.note_stall("log", detail=str(e))
+                    raise RecruitmentStalled("log", str(e)) from e
         self.registry.note_resumed("log")
         # Every storage must CONFIRM its rollback before the new
         # generation starts: an un-rolled-back replica above the quorum
         # truncation would diverge from its team. An unreachable storage
-        # host parks THIS recovery as a named stall; the controller
-        # resumes it when a storage worker registers.
-        for tag, ctrl in self.storage_ctrl.items():
-            for attempt in range(3):
-                req = StorageRollbackRequest(recovery_version)
-                ctrl.send(req)
-                got = await timeout(
-                    req.reply.future, SERVER_KNOBS.ROLE_RPC_TIMEOUT, _LOST
-                )
-                if got is not _LOST:
-                    break
-            else:
+        # host is first RE-RECRUITED from the registry's spares; only
+        # when none exists does this recovery park as a named stall the
+        # controller resumes when a storage worker registers.
+        for tag in sorted(self.storage_ctrl):
+            if await self._rollback_one(tag, recovery_version):
+                continue
+            await self._recruit_storage_host(tag)
+            if not await self._rollback_one(tag, recovery_version):
                 self.recovery_state = "recruiting_storage"
                 self.registry.note_stall(
-                    "storage", detail=f"storage {tag} unreachable"
+                    "storage", awaiting="storage", candidates=None,
+                    detail=f"storage {tag} unreachable",
                 )
                 raise RecruitmentStalled(
                     "storage",
@@ -1634,13 +1908,25 @@ class TxnHost:
         self.commit_ref.target = None
         self.location_ref.target = None
 
-    def start_controller(self, name: str = "cc0") -> None:
+    def start_controller(self, name: str = "cc0", on_lead=None,
+                         on_recovered=None) -> None:
         """Same election + health-probe + recover loop as the in-process
         tiers (RecoverableCluster.start_controller), with the recovery
         steps awaited over RPC and recruitment stalls PARKED: a
         RecruitmentStalled recovery waits on the registry's registration
         event (bounded by RECRUITMENT_STALL_RETRY_DELAY) instead of
-        crash-looping, and resumes the instant a worker registers."""
+        crash-looping, and resumes the instant a worker registers.
+
+        Controller FAILOVER: several candidates (txn hosts across
+        machines, sharing a `coordination_dir` quorum) may run this loop;
+        the lease arbitrates. `on_lead` fires when THIS candidate takes
+        the seat (publish the controller address so workers re-register
+        here — the registry is rebuilt from exactly those
+        re-registrations); `on_recovered` fires after each completed
+        recovery (publish the client-facing txn alias). A deposed leader
+        tears its transaction system down — its generation is fenced by
+        the successor's locks anyway, and a fenced corpse must not keep
+        answering status as if it served."""
         from ..core.errors import ActorCancelled
         from .recruitment import RecruitmentStalled
 
@@ -1655,18 +1941,33 @@ class TxnHost:
                 try:
                     if lease is None:
                         lease = self.election.try_become_leader(name)
-                        continue
-                    renewed = self.election.heartbeat(lease)
-                    if renewed is None:
-                        lease = None
-                        continue
-                    lease = renewed
+                        if lease is None:
+                            continue
+                        TraceEvent("ControllerSeatTaken").detail(
+                            "Name", name
+                        ).detail("Epoch", lease.epoch).log()
+                        if on_lead is not None:
+                            on_lead()
+                    else:
+                        renewed = self.election.heartbeat(lease)
+                        if renewed is None:
+                            TraceEvent("ControllerDeposed",
+                                       severity=30).detail(
+                                "Name", name
+                            ).log()
+                            lease = None
+                            self._stop_transaction_system()
+                            self.recovery_state = "deposed"
+                            continue
+                        lease = renewed
                     if not await self._txn_system_healthy():
                         TraceEvent("ControllerRecovering",
                                    severity=30).detail("Name", name).detail(
                             "Generation", self.generation
                         ).log()
                         await self.recover()
+                        if on_recovered is not None:
+                            on_recovered()
                 except (ActorCancelled, GeneratorExit):
                     raise
                 except RecruitmentStalled:
@@ -1878,8 +2179,6 @@ def run_role_host(role_class: str, cluster_file: str, datadir: str,
         mid = machine_id or machine_for_class(spec, role_class)
 
         async def main():
-            from .recruitment import RecruitmentStalled
-
             host = None
             reg_task = None
             # Flight-recorder query endpoint: EVERY role host serves its
@@ -1897,10 +2196,10 @@ def run_role_host(role_class: str, cluster_file: str, datadir: str,
                 if log_addrs is None:
                     return
                 host = StorageHost(transport, f"{datadir}/storage", spec,
-                                   log_addrs)
+                                   log_addrs, cluster_file=cluster_file)
             elif is_resolver_class(role_class):
                 host = ResolverHost(transport, spec)
-            elif role_class == "txn":
+            elif is_txn_class(role_class):
                 log_addrs = await _all_log_addrs()
                 storage_addr = await _wait_for(cluster_file, "storage",
                                                stopping)
@@ -1910,45 +2209,52 @@ def run_role_host(role_class: str, cluster_file: str, datadir: str,
                                for c in spec.get("ports", {}))
                 host = TxnHost(transport, f"{datadir}/txn", spec,
                                log_addrs, storage_addr,
-                               want_resolvers=want_res)
-                # Publish the CONTROLLER address before the boot
-                # recovery: resolver hosts must be able to REGISTER with
-                # the worker registry to un-stall it (the `txn` key
-                # stays recovery-gated below for the client contract).
-                write_cluster_file(
-                    cluster_file, {"controller": transport.local_address}
-                )
-                # Peers may still be coming up (or restarting): a stalled
-                # recruitment parks on the registration event; any other
-                # boot failure retries — but a SIGTERM must still win
-                # (peers may never come up).
-                while not stopping():
-                    try:
-                        await host.recover()
-                        break
-                    except RecruitmentStalled:
-                        await host.registry.wait_for_worker()
-                    except BaseException as e:  # noqa: BLE001
-                        TraceEvent("BootRecoveryRetry",
-                                   severity=30).error(e).log()
-                        await current_loop().delay(0.5)
-                host.start_controller("cc0")
+                               want_resolvers=want_res,
+                               cluster_file=cluster_file)
+                addr = transport.local_address
+
+                def on_lead():
+                    # Publish the CONTROLLER address the moment this
+                    # candidate takes the seat — BEFORE any recovery, so
+                    # workers (re-)register HERE and a stalled
+                    # recruitment can be un-stalled by exactly their
+                    # registration; after a failover the registry is
+                    # rebuilt from those re-registrations.
+                    write_cluster_file(cluster_file, {"controller": addr})
+
+                def on_recovered():
+                    # The client-facing alias stays RECOVERY-GATED: a
+                    # client that sees "txn" can commit immediately.
+                    write_cluster_file(cluster_file, {"txn": addr})
+
+                # Every txn host is a controller CANDIDATE: the election
+                # over the (optionally shared) coordination quorum
+                # arbitrates; the winner runs the boot recovery from
+                # inside the controller loop (an unhealthy probe — no
+                # proxy yet — IS the boot trigger), standbys park on the
+                # lease until the incumbent dies.
+                host.start_controller(f"{role_class}:{addr}",
+                                      on_lead=on_lead,
+                                      on_recovered=on_recovered)
             else:
                 raise ValueError(f"unknown process class {role_class!r}")
-            if role_class != "txn":
-                # Every non-controller host heartbeats into the worker
-                # registry (class + machine/failure-domain id): the
-                # registry is how recovery finds recruits and how their
-                # death is detected (lease lapse).
-                reg_task = start_worker_registration(
-                    transport, cluster_file, role_class, mid, stopping
-                )
+            # Every host — txn candidates included — heartbeats into the
+            # serving controller's worker registry (class + machine/
+            # failure-domain id): the registry is how recovery finds
+            # recruits and how their death is detected (lease lapse). The
+            # loop follows the cluster file's `controller` key, so a
+            # controller failover re-points every worker's registration.
+            reg_task = start_worker_registration(
+                transport, cluster_file, role_class, mid, stopping
+            )
             # Publish the address only once the endpoints are LIVE — a
             # peer reading the cluster file must never race this host's
-            # registration (txn publishes after its first recovery, so a
-            # client that sees "txn" can commit immediately).
-            write_cluster_file(cluster_file,
-                               {role_class: transport.local_address})
+            # registration. The legacy single-candidate class "txn" keeps
+            # its key recovery-gated (it doubles as the client alias the
+            # on_recovered callback owns).
+            if role_class != "txn":
+                write_cluster_file(cluster_file,
+                                   {role_class: transport.local_address})
             if ready is not None:
                 ready.address = transport.local_address
                 ready.set()

@@ -306,6 +306,14 @@ class RecoverableCluster:
             # The proxy itself proved it is fenced (a newer lock exists on
             # some log): unhealthy regardless of what a probe reply says.
             return False
+        wedge = getattr(self, "_wedge_probe", None)
+        if wedge is not None and wedge():
+            # The fault topology proved the commit plane is wedged on a
+            # durable role that re-recruitment can replace (a dark log
+            # whose host is dead past its lease): unhealthy even though
+            # the proxy answers every probe with a crisp TLogFailed —
+            # recovery is what performs the replacement.
+            return False
         from ..core.runtime import buggify, current_loop
 
         if buggify("controller_slow_probe"):

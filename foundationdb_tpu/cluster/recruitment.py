@@ -127,6 +127,25 @@ def select_workers(candidates: Iterable[WorkerInfo], role: str,
     return [t[5] for t in ranked[:count]]
 
 
+def select_replacement_hosts(candidates: Iterable[WorkerInfo], role: str,
+                             count: int = 1,
+                             max_fitness: Fitness = Fitness.WORST_FIT,
+                             exclude_machines: Iterable[str] = (),
+                             ) -> list[WorkerInfo]:
+    """Placement of a REPLACEMENT durable-role host (log/storage
+    re-recruitment, machine drains): the shared ranker with a failure-
+    domain exclusion — a machine already hosting a replica of the role's
+    serving set (or the machine being drained/buried) must not receive
+    another copy, or one machine loss would eat two replicas the
+    replication policy placed apart. Same total deterministic order as
+    select_workers; the fdblint det-recruit pack anchors on this function
+    too, so the sim tier's durable-role placement cannot silently unwire
+    from the shared path."""
+    excluded = frozenset(exclude_machines)
+    pool = [w for w in candidates if w.machine_id not in excluded]
+    return select_workers(pool, role, count, max_fitness=max_fitness)
+
+
 class RecruitmentStalled(OperationFailed):
     """No candidate worker for a role: recovery must PARK in a named
     ``recruiting_<role>`` state — visible in status json and TraceEvents,
@@ -169,6 +188,10 @@ class WorkerRegistry:
         self._change: AsyncVar = AsyncVar(0)
         self._bumps = 0
         self.stalls: dict[str, float] = {}   # role -> stalled-since
+        # role -> {detail, awaiting, candidates}: WHY the stall isn't
+        # draining (which worker class/tag is awaited + how many live
+        # candidates exist), for status json and `cli.py recruitment`.
+        self.stall_info: dict[str, dict] = {}
         self.stalls_total = 0
         self.recruits_total = 0
 
@@ -261,7 +284,8 @@ class WorkerRegistry:
         if len(got) < count:
             self.note_stall(
                 role, detail=f"{len(got)}/{count} candidates, "
-                             f"{len(self._workers)} registered"
+                             f"{len(self._workers)} registered",
+                awaiting=role, candidates=len(got),
             )
             raise RecruitmentStalled(
                 role, f"{len(got)}/{count} candidates"
@@ -277,7 +301,20 @@ class WorkerRegistry:
 
     # -- stall bookkeeping (also used by callers whose stall source is
     #    not the registry, e.g. an unreachable log quorum) --
-    def note_stall(self, role: str, detail: str = "") -> None:
+    def note_stall(self, role: str, detail: str = "",
+                   awaiting: Optional[str] = None,
+                   candidates: Optional[int] = None) -> None:
+        """Record a named recruiting_<role> stall. `awaiting` names the
+        worker class / storage tag the stall waits on and `candidates`
+        the number of live candidates ranked — the two facts an operator
+        needs to see WHY the stall isn't draining (surfaced in status
+        json and `cli.py recruitment`). Re-noting an active stall only
+        refreshes that context (the stalled-since clock keeps running)."""
+        self.stall_info[role] = {
+            "detail": detail,
+            "awaiting": awaiting if awaiting is not None else role,
+            "candidates": candidates,
+        }
         if role in self.stalls:
             return
         self.stalls[role] = current_loop().now()
@@ -285,11 +322,14 @@ class WorkerRegistry:
         TraceEvent("RecruitmentStalled", severity=30).detail(
             "Role", role
         ).detail("State", f"recruiting_{role}").detail(
-            "Detail", detail
-        ).log()
+            "Awaiting", awaiting if awaiting is not None else role
+        ).detail(
+            "Candidates", -1 if candidates is None else candidates
+        ).detail("Detail", detail).log()
 
     def note_resumed(self, role: str) -> None:
         since = self.stalls.pop(role, None)
+        self.stall_info.pop(role, None)
         if since is not None:
             TraceEvent("RecruitmentResumed").detail("Role", role).detail(
                 "StalledS", round(current_loop().now() - since, 3)
@@ -328,6 +368,16 @@ class WorkerRegistry:
             "stalls": {
                 role: round(now - since, 3)
                 for role, since in sorted(self.stalls.items())
+            },
+            # WHY each stall isn't draining: the awaited worker class /
+            # tag and the live candidate count (None = not computed by
+            # the caller) — `cli.py recruitment` renders these.
+            "stall_details": {
+                role: {
+                    "age_s": round(now - self.stalls.get(role, now), 3),
+                    **self.stall_info.get(role, {}),
+                }
+                for role in sorted(self.stalls)
             },
             "stalls_total": self.stalls_total,
             "recruits_total": self.recruits_total,

@@ -173,6 +173,9 @@ def _sharded_status(cluster) -> dict[str, Any]:
         # an active stall IS the recovery state (recovery is parked in
         # recruiting_<role> until a worker registers).
         st["cluster"]["recruitment"] = topo.registry.status()
+        # Per-machine placement + lifecycle (drain/retire state, re-homed
+        # slots): what `cli.py move-machine` is verified against.
+        st["cluster"]["machines"] = topo.machines_status()
         stalls = sorted(topo.registry.stalls)
         if stalls:
             st["cluster"]["recovery_state"] = {

@@ -184,6 +184,12 @@ class ServerKnobs(Knobs):
         init("WORKER_LEASE_TIMEOUT", 2.0, sim_random_range=(0.5, 4.0))
         init("RECRUITMENT_STALL_RETRY_DELAY", 0.5,
              sim_random_range=(0.05, 1.0))
+        # Recovery's storage-rollback confirm (multiprocess TxnHost):
+        # backoff between retries of an unanswered rollback RPC — three
+        # back-to-back sends against a dead host were a hot loop before
+        # the knob; randomized under sim like LOG_PUSH_RETRY_DELAY.
+        init("STORAGE_ROLLBACK_RETRY_DELAY", 0.2,
+             sim_random_range=(0.05, 0.5))
         # Data distribution (ref: fdbserver/Knobs.cpp DD section)
         init("MIN_SHARD_BYTES", 200000, sim_random_range=(5000, 200000))
         init("SHARD_BYTES_RATIO", 4)

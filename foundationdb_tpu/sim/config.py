@@ -86,6 +86,10 @@ _KNOB_RANGES = [
     ("WORKER_HEARTBEAT_INTERVAL", "server", (0.1, 1.0)),
     ("WORKER_LEASE_TIMEOUT", "server", (0.5, 4.0)),
     ("RECRUITMENT_STALL_RETRY_DELAY", "server", (0.05, 1.0)),
+    # r11: recovery's storage-rollback confirm backoff (durable-role
+    # re-recruitment tier) — draws near the lease horizon race the
+    # rollback retry against the park-and-recruit path.
+    ("STORAGE_ROLLBACK_RETRY_DELAY", "server", (0.05, 0.5)),
     # r10: flight-recorder sampling — 0 pins the unsampled commit path
     # (no per-commit RNG draw at all); positive draws thread debug IDs
     # through GRV/commit/resolve/tlog under the seed's chaos mix, so the

@@ -55,8 +55,15 @@ from __future__ import annotations
 from dataclasses import replace
 from typing import Optional
 
-from ..cluster.recruitment import WorkerInfo, WorkerRegistry, select_workers
+from ..cluster.recruitment import (
+    RecruitmentStalled,
+    WorkerInfo,
+    WorkerRegistry,
+    select_replacement_hosts,
+    select_workers,
+)
 from ..core.actors import ActorCollection
+from ..core.errors import OperationFailed
 from ..core.knobs import SERVER_KNOBS
 from ..core.runtime import TaskPriority, current_loop, spawn
 from ..core.trace import TraceEvent
@@ -82,6 +89,13 @@ class SimMachine:
         self.coordinator_ids: list[int] = []
         self.alive = True
         self.kills = 0
+        # Operator lifecycle (move-machine): `draining` marks a LIVE
+        # machine whose durable roles are being re-recruited elsewhere
+        # (its logs become donors of last resort — zero-loss demotion);
+        # `retired` is the terminal state: role-free, forgotten by the
+        # registry, never placed again and never restored.
+        self.draining = False
+        self.retired = False
 
     @property
     def protected(self) -> bool:
@@ -247,6 +261,27 @@ class MachineTopology:
                 self._machine_heartbeat(m), TaskPriority.COORDINATION,
                 name=f"workerBeat:{m.name}",
             ))
+        # Durable-role re-homing state: a recruited replacement takes
+        # over the dead replica's SLOT (tag/log index — routing is a pure
+        # function of the spec and never changes), so the physical
+        # placement must be tracked separately from the derived layout.
+        self._storage_homes: dict[int, SimMachine] = {}
+        self._log_paths: dict[int, str] = {}
+        self._storage_paths: dict[int, str] = {}
+        self._rehomes = 0
+        # The storage tracker: watches for storage machines dead past
+        # their lease, drives DD's team re-seeding off them, and recruits
+        # replacement hosts once drained (the reference's teamTracker +
+        # the controller's storage recruitment, merged at machine grain).
+        self._tasks.add(spawn(
+            self._storage_tracker(), TaskPriority.DEFAULT,
+            name="storageTracker",
+        ))
+        # Commit-plane wedge detection for the health probe: a push that
+        # can never reach its fsync quorum (dark log, host lease lapsed,
+        # replacement possible) must read as UNHEALTHY even though the
+        # proxy answers every probe with a crisp TLogFailed.
+        cluster._wedge_probe = self._durable_wedge_probe
         # Per-generation transaction roles are PLACED by the shared
         # fitness ranker at boot and re-placed by every recovery (hook
         # below) — the recruited-topology replacement of the historical
@@ -267,7 +302,7 @@ class MachineTopology:
         lease lapses in the registry."""
         loop = current_loop()
         while True:
-            if m.alive:
+            if m.alive and not m.retired:
                 self.registry.register(
                     m.name, process_class=m.process_class,
                     machine_id=m.name, dc=m.dc.index, index=m.index,
@@ -286,6 +321,13 @@ class MachineTopology:
             return
 
         def recover_and_place():
+            # Durable-role healing FIRST: a dead-past-its-lease (or
+            # draining) log host is replaced by a recruited machine and
+            # the survivors' tail re-replicated onto it BEFORE the epoch
+            # end, so lock() sees a whole, reachable quorum. A stalled
+            # replacement raises RecruitmentStalled and the controller
+            # parks the recovery (recruiting_log in status json).
+            self._replace_dead_logs()
             orig()
             self._place_txn_roles()
 
@@ -318,7 +360,8 @@ class MachineTopology:
                 + (1 if m.protected else 0)
                 + (1 if (m.log_ids or m.remote_log_ids) else 0),
             )
-            for m in self.machines if m.alive
+            for m in self.machines
+            if m.alive and not m.retired and not m.draining
         ]
         got = select_workers(candidates, "transaction", 1)
         if not got:
@@ -337,7 +380,348 @@ class MachineTopology:
         ).detail("Class", target.process_class).log()
 
     def machine_of_tag(self, tag: int) -> SimMachine:
+        home = self._storage_homes.get(tag)
+        if home is not None:
+            return home
         return self.machines[tag % len(self.machines)]
+
+    def _log_home(self, index: int) -> Optional[SimMachine]:
+        for m in self.machines:
+            if index in m.log_ids:
+                return m
+        return None
+
+    # -- durable-role re-recruitment (ref: the reference recruiting tlogs
+    #    onto any TransactionClass worker and re-replicating at epoch
+    #    end, and DD re-seeding storage teams; here at machine grain,
+    #    through the SAME ranker the multiprocess controller uses) --
+    def _durable_wedge_probe(self) -> bool:
+        """True when the commit path is wedged on a dark log whose host
+        is dead PAST ITS LEASE (or draining) and re-recruitment can
+        actually fix it — the trigger that turns the health probe's
+        crisp-but-useless TLogFailed replies into a recovery."""
+        ls = self.cluster.log_system
+        log_sets = getattr(ls, "log_sets", None)
+        if log_sets is None or len(log_sets) > 1:
+            return False  # regions: the remote-set failover owns this
+        if getattr(ls, "rep_factor", 1) < 2:
+            return False  # single replication: replacement == data loss
+        for i, log in enumerate(ls.logs):
+            if getattr(log, "reachable", True):
+                continue
+            host = self._log_home(i)
+            if host is None:
+                continue
+            if (host.draining or not self.registry.is_live(host.name)) \
+                    and self._rebuild_covered(i):
+                return True
+        return False
+
+    def _rebuild_covered(self, index: int) -> bool:
+        """True iff replacing log `index` loses nothing: every tag
+        destined to the slot has a REACHABLE donor replica (or the slot's
+        own copy is live — a drain). An uncovered rebuild would seed an
+        EMPTY replica whose zeroed durable cursor the next epoch-end
+        could count once the dark peers consume the exclusion budget —
+        computing a recovery version below every acked write and rolling
+        the whole cluster back to nothing (found by seed sweep: two log
+        machines dead at once, the first replaced while the second was
+        its only donor)."""
+        ls = self.cluster.log_system
+        serving = ls.logs
+        if getattr(serving[index], "reachable", True):
+            return True  # draining a live copy: it donates itself
+        for t in sorted(ls._registered_tags):
+            rs = ls.replica_set_for_tag(t)
+            if index not in rs:
+                continue
+            if not any(
+                i != index and i < len(serving)
+                and getattr(serving[i], "reachable", True)
+                for i in rs
+            ):
+                return False
+        return True
+
+    def _replace_dead_logs(self) -> None:
+        """Re-recruit every serving log whose host is draining or dead
+        past its lease: a replacement machine is ranked by the shared
+        ranker, a fresh log is built on it, and the surviving replicas'
+        tail is re-replicated (log_system.rebuild_log). Dark logs still
+        inside their lease only record the named stall — a blip is waited
+        out, exactly like the reference's failure-detection horizon."""
+        cluster = self.cluster
+        ls = cluster.log_system
+        log_sets = getattr(ls, "log_sets", None)
+        if log_sets is None or len(log_sets) > 1:
+            return
+        replaced = waiting = 0
+        for i in range(len(ls.logs)):
+            log = ls.logs[i]
+            host = self._log_home(i)
+            draining = host is not None and host.draining
+            dark = not getattr(log, "reachable", True)
+            if not (draining or dark):
+                continue
+            if dark and not draining:
+                if getattr(ls, "rep_factor", 1) < 2:
+                    # Replacement under single log replication cannot
+                    # invent the lost copy: stay wedged until the host
+                    # returns (the destroyed-datadir contract).
+                    continue
+                if host is not None and self.registry.is_live(host.name):
+                    # Dark inside its lease: a blip, not a death. Record
+                    # WHY recovery is parked so status/cli name the wait.
+                    self.registry.note_stall(
+                        "log", awaiting=host.name, candidates=None,
+                        detail=f"log{i} host {host.name} dark inside "
+                               "its lease",
+                    )
+                    waiting += 1
+                    continue
+                if not self._rebuild_covered(i):
+                    # A rebuild with no reachable donor for some destined
+                    # tag would seed an EMPTY replica that can poison the
+                    # epoch-end quorum (recovery version 0 == total
+                    # rollback). Keep the dark copy — its in-process
+                    # state is still addressable (kill == blackout) and
+                    # the peers' return is what heals this.
+                    self.registry.note_stall(
+                        "log", awaiting="a reachable donor replica",
+                        candidates=None,
+                        detail=f"log{i} dead but some destined tag has "
+                               "no reachable donor; replacement would "
+                               "lose acked writes",
+                    )
+                    waiting += 1
+                    continue
+            target = self._recruit_log_host(i, host)
+            fresh = self._build_replacement_log(i, target)
+            old = ls.rebuild_log(i, fresh)
+            if hasattr(old, "stop"):
+                old.stop()
+            if host is not None and i in host.log_ids:
+                host.log_ids.remove(i)
+            target.log_ids.append(i)
+            fresh.reachable = target.alive
+            replaced += 1
+            TraceEvent("SimLogRehomed").detail("Log", i).detail(
+                "From", host.name if host else "?"
+            ).detail("To", target.name).log()
+        if replaced and not waiting:
+            self.registry.note_resumed("log")
+
+    def _recruit_log_host(self, index: int, dead: Optional[SimMachine]
+                          ) -> SimMachine:
+        """Rank a replacement machine for log slot `index`. Machines
+        already hosting any log replica are excluded outright (one
+        machine must never hold two copies the policy placed apart), as
+        are protected (coordinator) machines — the quorum's failure
+        domain never hosts killable durable state."""
+        exclude = {m.name for m in self.machines
+                   if m.log_ids or m.remote_log_ids}
+        if dead is not None:
+            exclude.add(dead.name)
+        candidates = [
+            WorkerInfo(
+                worker_id=m.name, process_class=m.process_class,
+                machine_id=m.name, dc=m.dc.index, index=m.index,
+                penalty=(2 if not self.registry.is_live(m.name) else 0)
+                + (1 if m.has_txn else 0),
+            )
+            for m in self.machines
+            if m.alive and not m.retired and not m.draining
+            and not m.protected
+        ]
+        got = select_replacement_hosts(candidates, "log", 1,
+                                       exclude_machines=exclude)
+        if not got:
+            self.registry.note_stall(
+                "log", awaiting="log-class worker", candidates=0,
+                detail=f"log{index} host dead; no replacement machine "
+                       "registered",
+            )
+            raise RecruitmentStalled(
+                "log", f"no replacement machine for log{index}"
+            )
+        return next(m for m in self.machines
+                    if m.name == got[0].worker_id)
+
+    def _build_replacement_log(self, index: int, target: SimMachine):
+        cluster = self.cluster
+        if getattr(cluster, "datadir", None):
+            from ..cluster.durable_tlog import DurableTaggedTLog
+
+            self._rehomes += 1
+            path = f"{cluster.datadir}/log{index}.r{self._rehomes}"
+            self._log_paths[index] = path
+            return DurableTaggedTLog(
+                path, os_layer=getattr(cluster, "os_layer", None)
+            )
+        from ..cluster.log_system import TaggedTLog
+
+        return TaggedTLog(0)
+
+    async def _storage_tracker(self) -> None:
+        """Watch for storage machines dead past their lease: feed DD's
+        team machinery (mark_failed -> existing move_keys re-seeding off
+        the dead replicas), then — once the dead tag holds no shard —
+        recruit a replacement host and rebuild the server there so the
+        replica slot returns to service. Stalls are named, bounded-retry
+        (next tick), and drain when a machine registers."""
+        from ..core.errors import ActorCancelled
+
+        loop = current_loop()
+        while True:
+            await loop.delay(
+                SERVER_KNOBS.RATEKEEPER_UPDATE_INTERVAL
+                * (0.8 + 0.4 * loop.random.random01())
+            )
+            try:
+                self._heal_dead_storage()
+            except RecruitmentStalled:
+                pass  # stall recorded; re-ranked next tick
+            except (ActorCancelled, GeneratorExit):
+                raise
+            except BaseException as e:  # noqa: BLE001 — tracker survives
+                TraceEvent("StorageTrackerError", severity=30).error(e).log()
+
+    def _heal_dead_storage(self) -> None:
+        dd = getattr(self.cluster, "dd", None)
+        if dd is None:
+            return
+        pending: list[tuple[int, SimMachine]] = []
+        for m in self.machines:
+            if m.alive or m.retired:
+                continue
+            if self.registry.is_live(m.name):
+                continue  # inside its lease: a blip, not a death
+            for t in sorted(m.storage_tags):
+                pending.append((t, m))
+        if not pending:
+            if "storage" in self.registry.stalls:
+                self.registry.note_resumed("storage")
+            return
+        for t, _m in pending:
+            dd.mark_failed(t)
+        for t, m in pending:
+            if any(t in team
+                   for _b, _e, team in self.cluster.shard_map.ranges()):
+                # DD is still re-seeding this tag's shards onto live
+                # teams; the replacement waits for the drain.
+                self.registry.note_stall(
+                    "storage", awaiting=f"tag {t} drain",
+                    candidates=None,
+                    detail=f"storage {t} dead on {m.name}; teams "
+                           "re-seeding",
+                )
+                continue
+            self._rehome_storage(t, m)
+
+    def _rehome_storage(self, tag: int, dead: SimMachine) -> None:
+        from ..cluster.sharded_cluster import _all_false_map, _make_engine
+        from ..cluster.storage import StorageServer
+
+        cluster = self.cluster
+        candidates = [
+            WorkerInfo(
+                worker_id=m.name, process_class=m.process_class,
+                machine_id=m.name, dc=m.dc.index, index=m.index,
+                penalty=(2 if not self.registry.is_live(m.name) else 0)
+                + (1 if (m.log_ids or m.remote_log_ids) else 0)
+                + (1 if m.has_txn else 0),
+            )
+            for m in self.machines
+            if m.alive and not m.retired and not m.draining
+            and not m.protected
+        ]
+        got = select_replacement_hosts(candidates, "storage", 1,
+                                       exclude_machines={dead.name})
+        if not got:
+            self.registry.note_stall(
+                "storage", awaiting=f"storage-class worker (tag {tag})",
+                candidates=0,
+                detail=f"storage {tag} drained; no replacement machine",
+            )
+            raise RecruitmentStalled(
+                "storage", f"no replacement machine for storage {tag}"
+            )
+        target = next(m for m in self.machines
+                      if m.name == got[0].worker_id)
+        old = cluster.storages[tag]
+        engine = None
+        if getattr(cluster, "datadir", None):
+            self._rehomes += 1
+            path = f"{cluster.datadir}/storage{tag}.r{self._rehomes}"
+            self._storage_paths[tag] = path
+            engine = _make_engine(self.engine_kind, path,
+                                  os_layer=getattr(cluster, "os_layer",
+                                                   None))
+        fresh = StorageServer(cluster.log_system.tag_view(tag), 0,
+                              tag=tag, engine=engine)
+        # Clients keep their endpoint (the reference's interface tokens
+        # survive role restarts); ownership starts EMPTY — DD's move_keys
+        # seeds data in with a proper fence+snapshot fetch when a team
+        # next includes this replica.
+        fresh.read_stream = old.read_stream
+        fresh.owned = _all_false_map()
+        fresh.assigned = _all_false_map()
+        cluster.storages[tag] = fresh
+        fresh.start()
+        if tag in dead.storage_tags:
+            dead.storage_tags.remove(tag)
+        target.storage_tags.append(tag)
+        self._storage_homes[tag] = target
+        dd = getattr(cluster, "dd", None)
+        if dd is not None:
+            dd.mark_healthy(tag)
+        self.registry.note_resumed("storage")
+        TraceEvent("SimStorageRehomed").detail("Tag", tag).detail(
+            "From", dead.name
+        ).detail("To", target.name).log()
+
+    def retire_machine(self, m: SimMachine) -> None:
+        """Terminal step of a machine drain: the machine must already be
+        role-free (storage excluded + drained, logs demoted, txn bundle
+        re-placed). Forgotten by the registry, never placed or restored
+        again — the operator can power it off."""
+        if m.protected:
+            raise OperationFailed(
+                f"machine {m.name} hosts coordinators; move the "
+                "coordination quorum first"
+            )
+        if (m.storage_tags or m.log_ids or m.remote_log_ids
+                or m.has_txn):
+            raise OperationFailed(
+                f"machine {m.name} still hosts roles "
+                f"(storage={m.storage_tags} logs={m.log_ids} "
+                f"txn={m.has_txn}); drain before retiring"
+            )
+        m.retired = True
+        m.draining = False
+        self.registry.forget(m.name)
+        TraceEvent("SimMachineRetired").detail("Machine", m.name).log()
+
+    def machines_status(self) -> list[dict]:
+        """Per-machine placement + lifecycle for status json: which
+        roles each failure domain hosts right now (re-homed slots
+        included), and whether its registry lease is live."""
+        return [
+            {
+                "machine": m.name,
+                "dc": m.dc.name,
+                "alive": m.alive,
+                "retired": m.retired,
+                "draining": m.draining,
+                "protected": m.protected,
+                "storage_tags": sorted(m.storage_tags),
+                "logs": sorted(m.log_ids),
+                "remote_logs": sorted(m.remote_log_ids),
+                "txn": m.has_txn,
+                "live_lease": self.registry.is_live(m.name),
+            }
+            for m in self.machines
+        ]
 
     def database(self):
         """A client database whose every hop crosses the SimNetwork from
@@ -379,20 +763,23 @@ class MachineTopology:
         one machine stays up to host the re-recruited transaction roles.
         The attrition nemesis gates every kill on this — the simulator
         must drive the cluster to the edge, never over it."""
-        dead = {m.index for m in self.machines if not m.alive}
+        dead = {m.index for m in self.machines if not m.alive or m.retired}
         dead |= {m.index for m in machines}
         if all(m.index in dead for m in self.machines):
             return False
-        n = len(self.machines)
         for _b, _e, team in self.cluster.shard_map.ranges():
-            if team and all(t % n in dead for t in team):
+            # Placement via machine_of_tag, not t % n: a re-homed
+            # replica's quorum safety follows its CURRENT machine.
+            if team and all(self.machine_of_tag(t).index in dead
+                            for t in team):
                 return False
         return True
 
     def killable_machines(self) -> list[SimMachine]:
         return [
             m for m in self.machines
-            if m.alive and not m.protected and self.can_kill([m])
+            if m.alive and not m.protected and not m.retired
+            and self.can_kill([m])
         ]
 
     # -- the fault arsenal --
@@ -453,7 +840,7 @@ class MachineTopology:
                 log_sets[1][i].reachable = up
 
     def restore_machine(self, m: SimMachine) -> None:
-        if m.alive:
+        if m.alive or m.retired:
             return
         m.alive = True
         self.net.restore(m.proc)
@@ -466,6 +853,19 @@ class MachineTopology:
             m.name, process_class=m.process_class, machine_id=m.name,
             dc=m.dc.index, index=m.index, penalty=1 if m.protected else 0,
         )
+        # Its storage replicas (if not already re-homed) are healthy
+        # again: re-admit them before DD moves yet more data around.
+        dd = getattr(self.cluster, "dd", None)
+        if dd is not None:
+            for t in sorted(m.storage_tags):
+                dd.mark_healthy(t)
+        if "log" in self.registry.stalls and not any(
+            not getattr(log, "reachable", True)
+            for log in self.cluster.log_system.logs
+        ):
+            # The dark-log wait drained by the host coming back (no
+            # replacement happened): clear the named stall.
+            self.registry.note_resumed("log")
         if self.registry.stalls:
             self._place_txn_roles()
         TraceEvent("SimMachineRestored").detail("Machine", m.name).log()
@@ -490,8 +890,13 @@ class MachineTopology:
     def _power_loss(self, m: SimMachine) -> None:
         cluster = self.cluster
         datadir = cluster.datadir
-        prefixes = [f"{datadir}/storage{t}" for t in m.storage_tags]
-        prefixes += [f"{datadir}/log{i}" for i in m.log_ids]
+        # Re-homed slots live under their replacement incarnation's path.
+        s_path = lambda t: self._storage_paths.get(  # noqa: E731
+            t, f"{datadir}/storage{t}")
+        l_path = lambda i: self._log_paths.get(  # noqa: E731
+            i, f"{datadir}/log{i}")
+        prefixes = [s_path(t) for t in m.storage_tags]
+        prefixes += [l_path(i) for i in m.log_ids]
         prefixes += [f"{datadir}/rlog{i}" for i in m.remote_log_ids]
         stats = self.disk.kill(prefixes=prefixes)
         TraceEvent("SimPowerLoss", severity=30).detail(
@@ -505,8 +910,7 @@ class MachineTopology:
         from ..cluster.storage import StorageServer
 
         log_sets = cluster.log_system.log_sets
-        rebuilt = [(log_sets[0], i, f"{datadir}/log{i}")
-                   for i in m.log_ids]
+        rebuilt = [(log_sets[0], i, l_path(i)) for i in m.log_ids]
         if len(log_sets) > 1:
             rebuilt += [(log_sets[1], i, f"{datadir}/rlog{i}")
                         for i in m.remote_log_ids]
@@ -522,8 +926,7 @@ class MachineTopology:
             log_set[i] = fresh
         for t in m.storage_tags:
             old = cluster.storages[t]  # already stopped by the kill
-            engine = _make_engine(self.engine_kind,
-                                  f"{datadir}/storage{t}",
+            engine = _make_engine(self.engine_kind, s_path(t),
                                   os_layer=self.disk)
             fresh = StorageServer(cluster.log_system.tag_view(t), 0,
                                   tag=t, engine=engine)

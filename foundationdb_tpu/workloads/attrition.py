@@ -27,7 +27,8 @@ from ..core.trace import TraceEvent
 class MachineAttritionWorkload:
     def __init__(self, topology, interval: float = 0.8, kills: int = 2,
                  reboots: int = 1, swizzles: int = 1, dc_kills: int = 0,
-                 permanent_kills: int = 0, outage: float = 0.4,
+                 permanent_kills: int = 0, permanent_log_kills: int = 0,
+                 permanent_storage_kills: int = 0, outage: float = 0.4,
                  max_clog: float = 0.6, power_loss: bool = False,
                  name: str = "machine-attrition"):
         self.topo = topology
@@ -42,14 +43,23 @@ class MachineAttritionWorkload:
         # "permkill" is the PERMANENT machine loss (no restore until the
         # closing heal): the shared-fate scenario the recruitment path
         # must survive by re-placing the dead machine's roles elsewhere.
+        # The "permkill_log"/"permkill_storage" variants TARGET machines
+        # hosting those durable roles — the log/storage re-recruitment
+        # paths (replacement host recruited from the registry, tail
+        # re-replicated / teams re-seeded) instead of whatever machine
+        # the PRNG happens to draw.
         self.deck = (["kill"] * kills + ["reboot"] * reboots
                      + ["swizzle"] * swizzles + ["dc"] * dc_kills
-                     + ["permkill"] * permanent_kills)
+                     + ["permkill"] * permanent_kills
+                     + ["permkill_log"] * permanent_log_kills
+                     + ["permkill_storage"] * permanent_storage_kills)
         self.kills_done = 0
         self.reboots_done = 0
         self.swizzles_done = 0
         self.dc_kills_done = 0
         self.permanent_kills_done = 0
+        self.permanent_log_kills_done = 0
+        self.permanent_storage_kills_done = 0
         self.refused = 0
         self._task = None
 
@@ -89,18 +99,32 @@ class MachineAttritionWorkload:
                         self.outage * (0.3 + 0.7 * random.random01())
                     )
                     self.topo.restore_machine(m)
-            elif action == "permkill":
+            elif action in ("permkill", "permkill_log",
+                            "permkill_storage"):
                 # PERMANENT loss: no restore — the cluster must
                 # re-recruit the dead machine's roles onto a survivor
                 # (quorum-safety-gated like every kill; _heal revives
-                # everything for the closing checks).
+                # everything for the closing checks). The targeted
+                # variants draw only from machines hosting the named
+                # durable role, so every such seed exercises log tail
+                # re-replication / storage team re-seeding.
                 targets = self.topo.killable_machines()
+                if action == "permkill_log":
+                    targets = [m for m in targets if m.log_ids]
+                elif action == "permkill_storage":
+                    targets = [m for m in targets
+                               if m.storage_tags and not m.log_ids]
                 if not targets:
                     self.refused += 1
                     continue
                 m = self._pick(random, targets)
                 if self.topo.kill_machine(m):
-                    self.permanent_kills_done += 1
+                    if action == "permkill_log":
+                        self.permanent_log_kills_done += 1
+                    elif action == "permkill_storage":
+                        self.permanent_storage_kills_done += 1
+                    else:
+                        self.permanent_kills_done += 1
             elif action == "reboot":
                 targets = self.topo.killable_machines()
                 if not targets:
@@ -151,7 +175,9 @@ class MachineAttritionWorkload:
             return False
         acted = (self.kills_done + self.reboots_done
                  + self.swizzles_done + self.dc_kills_done
-                 + self.permanent_kills_done)
+                 + self.permanent_kills_done
+                 + self.permanent_log_kills_done
+                 + self.permanent_storage_kills_done)
         # At least one action must actually have landed (a nemesis whose
         # every move was refused tested nothing).
         return acted > 0 or not self.deck
@@ -163,6 +189,8 @@ class MachineAttritionWorkload:
             "swizzles": self.swizzles_done,
             "dc_kills": self.dc_kills_done,
             "permanent_kills": self.permanent_kills_done,
+            "permanent_log_kills": self.permanent_log_kills_done,
+            "permanent_storage_kills": self.permanent_storage_kills_done,
             "refused": self.refused,
             "protected_kill_attempts": self.topo.protected_kill_attempts,
         }

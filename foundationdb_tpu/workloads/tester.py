@@ -319,6 +319,9 @@ async def _run_workloads(cluster, db, spec) -> dict[str, Any]:
                 swizzles=w.get("swizzles", 1),
                 dc_kills=w.get("dc_kills", 0),
                 permanent_kills=w.get("permanent_kills", 0),
+                permanent_log_kills=w.get("permanent_log_kills", 0),
+                permanent_storage_kills=w.get(
+                    "permanent_storage_kills", 0),
                 outage=w.get("outage", 0.4),
                 power_loss=w.get("power_loss", False),
                 name=f"machine-attrition-{rkey}",
@@ -372,6 +375,29 @@ async def _run_workloads(cluster, db, spec) -> dict[str, Any]:
         from ..core import delay
 
         await delay(1.0)  # let replicas drain their tags
+        dd = getattr(cluster, "dd", None)
+        if dd is not None:
+            # DD (and the topology's storage tracker feeding it) keeps
+            # healing after the nemesis's closing heal — late lease
+            # lapses re-seed teams off machines that died near the end.
+            # The replica compare below must not race a half-move's
+            # union team: quiesce first (mover idle, no unplaceable
+            # member left in any team), bounded so a wedged move still
+            # surfaces as the check failure it is.
+            from ..core.runtime import current_loop
+
+            loop = current_loop()
+            deadline = loop.now() + 60.0
+            while loop.now() < deadline:
+                bad = dd._unplaceable()
+                dirty = any(
+                    t in bad
+                    for _b, _e, team in cluster.shard_map.ranges()
+                    for t in team
+                )
+                if not cluster.move_keys_lock._held and not dirty:
+                    break
+                await delay(0.25)
         cc = ConsistencyCheckWorkload(cluster)
         results["ConsistencyCheck"] = {"ok": bool(await cc.check()),
                                        "failures": cc.failures}

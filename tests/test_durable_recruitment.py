@@ -1,0 +1,639 @@
+"""Durable-state self-healing (PR 11): log/storage re-recruitment,
+controller failover, and the `move-machine` drain verb.
+
+Covers the tentpole contracts on top of PR 9's stateless recruitment:
+
+- sim tier: a PERMANENTLY killed log host's slot is re-recruited onto a
+  ranked replacement machine and the surviving replicas' tail is
+  re-replicated onto it (`log_system.rebuild_log`) — the recovery enters
+  `recruiting_log`, drains, commits resume, and the final keyspace
+  fingerprint matches a no-fault run;
+- sim tier: a permanently killed storage host's shards re-seed through
+  DD's team machinery and a replacement host is recruited once drained
+  (same fingerprint contract);
+- `WorkerRegistry.forget` fast-fail for the new log/storage classes: a
+  worker that flunks a recruitment confirm must not be re-selected
+  before it re-registers;
+- stall observability: `stall_details` names the awaited worker/tag and
+  the candidate count (status json + `cli.py recruitment`);
+- `cli.py move-machine` drains a live machine with zero acked-write loss
+  and the machine ends excluded + role-free in status json;
+- multiprocess (slow): the controller's machine group SIGKILLed — a
+  candidate on another machine takes the seat over the shared
+  coordination quorum, workers re-register, and an in-flight
+  `recruiting_resolver` stall drains under the new controller.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from foundationdb_tpu.cluster.recruitment import (
+    Fitness,
+    RecruitmentStalled,
+    WorkerInfo,
+    WorkerRegistry,
+    select_replacement_hosts,
+)
+from foundationdb_tpu.core import loop_context
+from foundationdb_tpu.core.runtime import sim_loop
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# the replacement ranker + registry fast-fail + stall detail
+# ---------------------------------------------------------------------------
+
+def test_select_replacement_hosts_excludes_replica_machines():
+    ws = [
+        WorkerInfo("spare-a", process_class="unset", machine_id="m4",
+                   index=4),
+        WorkerInfo("log-host", process_class="log", machine_id="m0",
+                   index=0),
+        WorkerInfo("spare-b", process_class="unset", machine_id="m5",
+                   index=5),
+    ]
+    # The machine already hosting a log replica is excluded OUTRIGHT even
+    # though its class ranks Best — one machine must never hold two
+    # copies the policy placed apart.
+    got = select_replacement_hosts(ws, "log", 2,
+                                   exclude_machines={"m0"})
+    assert [w.worker_id for w in got] == ["spare-a", "spare-b"]
+    # Without the exclusion the log-class machine wins on fitness.
+    got = select_replacement_hosts(ws, "log", 1)
+    assert [w.worker_id for w in got] == ["log-host"]
+
+
+def test_registry_forget_fast_fails_log_and_storage_classes(sim):
+    """A log/storage worker that flunks a recruitment confirm is
+    forgotten and MUST NOT be re-selected before its next registration
+    (the resolver path has this contract; the durable roles now share
+    it)."""
+    reg = WorkerRegistry()
+    reg.start()
+    try:
+        for cls, role in (("log1", "log"), ("storage", "storage")):
+            reg.register(f"{cls}@a:1", process_class=cls, address="a:1")
+            assert reg.best_worker(role, max_fitness=Fitness.BEST) \
+                .worker_id == f"{cls}@a:1"
+            reg.forget(f"{cls}@a:1")
+            # Not merely demoted — gone until it re-registers, well
+            # before any lease could have lapsed.
+            assert reg.best_worker(role, max_fitness=Fitness.BEST) is None
+            with pytest.raises(RecruitmentStalled):
+                reg.recruit(role, 1, max_fitness=Fitness.BEST)
+            reg.note_resumed(role)
+            # One beat re-admits it (a live worker loses nothing).
+            reg.register(f"{cls}@a:1", process_class=cls, address="a:1")
+            assert reg.best_worker(role, max_fitness=Fitness.BEST) \
+                .worker_id == f"{cls}@a:1"
+    finally:
+        reg.stop()
+
+
+def test_stall_details_name_awaited_worker_and_candidates(sim):
+    reg = WorkerRegistry()
+    reg.note_stall("log", detail="log1 host dead", awaiting="log1",
+                   candidates=0)
+    st = reg.status()
+    assert st["stalls"].keys() == {"log"}
+    d = st["stall_details"]["log"]
+    assert d["awaiting"] == "log1"
+    assert d["candidates"] == 0
+    assert "dead" in d["detail"]
+    assert d["age_s"] >= 0
+    # recruit()'s own stall records the candidate count too.
+    with pytest.raises(RecruitmentStalled):
+        reg.recruit("storage", 2, max_fitness=Fitness.BEST)
+    d = reg.status()["stall_details"]["storage"]
+    assert d["candidates"] == 0 and d["awaiting"] == "storage"
+    reg.note_resumed("log")
+    assert "log" not in reg.status()["stall_details"]
+
+
+# ---------------------------------------------------------------------------
+# sim tier: durable-role re-recruitment (the acceptance scenarios)
+# ---------------------------------------------------------------------------
+
+def _topo_cluster(**kw):
+    from foundationdb_tpu.cluster.recovery import RecoverableShardedCluster
+    from foundationdb_tpu.sim.topology import MachineTopology
+
+    topo_kw = kw.pop("topo", {"n_dcs": 1, "machines_per_dc": 6})
+    base = dict(n_storage=6, n_logs=2, replication="double",
+                log_replication="double", shard_boundaries=[b"m"],
+                topology=topo_kw)
+    base.update(kw)
+    cluster = RecoverableShardedCluster(**base).start()
+    topo = MachineTopology(cluster, **topo_kw)
+    cluster.sim_topology = topo
+    return cluster, topo
+
+
+def _run_log_kill(seed: int, kill: bool):
+    """One sim run writing 20 keys; with `kill`, machine m1 (hosting log
+    1 + storage 1) is SIGKILL-equivalently killed — permanently, no
+    restore — between the two write phases. Returns (final keyspace,
+    events dict)."""
+    from foundationdb_tpu.cluster.status import cluster_status
+
+    loop = sim_loop(seed=seed)
+    out: dict = {}
+    ev = {"stalled": False, "rehomed": False, "recruiting_seen": False}
+    with loop_context(loop):
+        cluster, topo = _topo_cluster()
+        db = topo.database()
+
+        async def main():
+            cluster.start_controller("logkill")
+            for i in range(10):
+                await db.set(b"k%d" % i, b"v%d" % i)
+            if kill:
+                m1 = topo.machines[1]
+                assert m1.log_ids == [1] and not m1.protected
+                old_log = cluster.log_system.logs[1]
+                assert topo.kill_machine(m1)
+                # Recovery first PARKS in recruiting_log (the host is
+                # dark inside its lease: a blip is waited out) ...
+                deadline = loop.now() + 30
+                while loop.now() < deadline:
+                    if "log" in topo.registry.stalls:
+                        ev["stalled"] = True
+                        st = cluster_status(cluster)
+                        ev["recruiting_seen"] = (
+                            st["cluster"]["recovery_state"]["name"]
+                            == "recruiting_log"
+                        )
+                        break
+                    await loop.delay(0.1)
+                # ... then the lease lapses and the slot is re-recruited
+                # onto a ranked spare, the survivors' tail re-replicated.
+                deadline = loop.now() + 60
+                while loop.now() < deadline:
+                    home = topo._log_home(1)
+                    fresh = cluster.log_system.logs[1]
+                    if home is not None and home is not m1 \
+                            and fresh is not old_log \
+                            and getattr(fresh, "reachable", True):
+                        ev["rehomed"] = True
+                        break
+                    await loop.delay(0.25)
+                assert ev["rehomed"], "log 1 never re-homed"
+                assert "log" not in topo.registry.stalls
+            for i in range(10, 20):
+                await db.set(b"k%d" % i, b"v%d" % i)
+            for i in range(20):
+                out[b"k%d" % i] = await db.get(b"k%d" % i)
+            cluster.stop()
+
+        loop.run(main(), timeout_sim_seconds=600)
+    loop.shutdown()
+    return out, ev
+
+
+def test_sim_log_host_permanent_kill_rerecruits_and_rereplicates():
+    """THE log acceptance: permanent kill of a log host — recovery
+    enters recruiting_log, a spare worker is recruited, the log set
+    re-replicates onto it, commits resume, and the final keyspace
+    fingerprint matches a no-fault run bit for bit."""
+    with_kill, ev = _run_log_kill(31, kill=True)
+    assert ev["stalled"] and ev["recruiting_seen"], ev
+    no_fault, _ = _run_log_kill(31, kill=False)
+    assert with_kill == no_fault
+    assert len(with_kill) == 20
+    assert all(v is not None for v in with_kill.values())
+
+
+def test_sim_log_rebuild_rereplicates_destined_tail(sim):
+    """The re-replication itself: the recruited replacement holds every
+    un-popped version destined to its slot (union of the survivors'
+    durable entries), so a later loss of the OTHER replica still loses
+    nothing."""
+    from foundationdb_tpu.cluster.log_system import (
+        TaggedMutation,
+        TaggedTLog,
+        TagPartitionedLogSystem,
+    )
+    from foundationdb_tpu.cluster.interfaces import Mutation
+    from foundationdb_tpu.kv.atomic import MutationType
+
+    async def main():
+        ls = TagPartitionedLogSystem(2, log_replication="double")
+        ls.tag_view(0), ls.tag_view(1)
+        for v in range(1, 6):
+            tms = [TaggedMutation((v % 2,), Mutation(
+                MutationType.SET_VALUE, b"k%d" % v, b"v%d" % v))]
+            await ls.push(v - 1, v, tms)
+        # Replica 1 dies; a fresh log takes its slot.
+        ls.logs[1].reachable = False
+        fresh = TaggedTLog(0)
+        old = ls.rebuild_log(1, fresh)
+        assert old is not fresh and ls.logs[1] is fresh
+        assert fresh.reachable is not False or True
+        # Every version is destined to BOTH logs under double
+        # replication: the rebuilt copy serves the full tail.
+        got = await fresh.peek_tag(0, 0)
+        assert [v for v, _ in got] == [1, 2, 3, 4, 5]
+        muts = [m for _, ms in got for m in ms]
+        assert [m.param1 for m in muts] == [b"k2", b"k4"]
+        # Cursor state seeded: the epoch-end quorum sees an honest,
+        # non-gapped replica (durable at the donors' top).
+        assert fresh.durable.get() == 5
+        assert fresh.version.get() == 5
+
+    sim.run(main(), timeout_sim_seconds=60)
+
+
+def test_durable_log_seed_survives_reopen(tmp_path, sim):
+    """The durable tier's seed is fsynced BEFORE cursors advance: a
+    power loss right after the seed replays the same tail."""
+    from foundationdb_tpu.cluster.durable_tlog import DurableTaggedTLog
+    from foundationdb_tpu.cluster.interfaces import Mutation
+    from foundationdb_tpu.cluster.log_system import TaggedMutation
+    from foundationdb_tpu.kv.atomic import MutationType
+
+    path = str(tmp_path / "seeded")
+    log = DurableTaggedTLog(path)
+    entries = [
+        (v, [TaggedMutation((0,), Mutation(
+            MutationType.SET_VALUE, b"k%d" % v, b"v%d" % v))])
+        for v in (3, 4)
+    ]
+    log.seed_rebuilt_state(entries, 7, popped_by_tag={0: 2})
+    assert log.version.get() == 7 and log.quorum_durable() == 7
+    log.close()
+    reopened = DurableTaggedTLog(path)
+    try:
+        assert [v for v, _ in reopened._entries] == [3, 4, 7]
+        assert reopened.version.get() == 7
+        assert reopened._popped_by_tag.get(0) == 2
+    finally:
+        reopened.close()
+
+
+def _run_storage_kill(seed: int, kill: bool):
+    loop = sim_loop(seed=seed)
+    out: dict = {}
+    ev = {"reseeded": False, "rehomed": False}
+    with loop_context(loop):
+        cluster, topo = _topo_cluster()
+        db = topo.database()
+        cluster.start_data_distribution(interval=0.2)
+
+        async def main():
+            cluster.start_controller("storagekill")
+            for i in range(10):
+                await db.set(b"k%d" % i, b"v%d" % i)
+            if kill:
+                m2 = topo.machines[2]
+                assert m2.storage_tags == [2] and not m2.log_ids
+                assert topo.kill_machine(m2)
+                deadline = loop.now() + 120
+                while loop.now() < deadline:
+                    teams = cluster.shard_map.teams()
+                    drained = all(2 not in t for t in teams)
+                    home = topo._storage_homes.get(2)
+                    if drained and home is not None and home is not m2:
+                        ev["reseeded"] = drained
+                        ev["rehomed"] = True
+                        break
+                    await loop.delay(0.25)
+                assert ev["rehomed"], "storage 2 never re-homed"
+                # The replacement starts EMPTY and unowned: data reaches
+                # it only through proper fence+snapshot fetches.
+                s2 = cluster.storages[2]
+                assert len(s2.data) == 0
+            for i in range(10, 20):
+                await db.set(b"k%d" % i, b"v%d" % i)
+            for i in range(20):
+                out[b"k%d" % i] = await db.get(b"k%d" % i)
+            cluster.stop()
+
+        loop.run(main(), timeout_sim_seconds=600)
+    loop.shutdown()
+    return out, ev
+
+
+def test_sim_storage_host_permanent_kill_team_reseed():
+    """THE storage acceptance: permanent kill of a storage host — DD's
+    team machinery re-seeds every shard off the dead replica, a
+    replacement host is recruited once drained, commits and reads keep
+    flowing, and the fingerprint matches a no-fault run."""
+    with_kill, ev = _run_storage_kill(47, kill=True)
+    assert ev["reseeded"] and ev["rehomed"], ev
+    no_fault, _ = _run_storage_kill(47, kill=False)
+    assert with_kill == no_fault
+    assert len(with_kill) == 20
+    assert all(v is not None for v in with_kill.values())
+
+
+def test_sim_log_stall_parks_then_drains_onto_registered_spare():
+    """No candidate machine => recovery PARKS in recruiting_log with the
+    awaited class and candidate count in status; a spare machine
+    registering is what drains it — the replacement lands exactly
+    there."""
+    loop = sim_loop(seed=53)
+    with loop_context(loop):
+        # 6 machines: logs on m0/m1, coordinators protect m3..m5 (never
+        # log candidates), so m2 is the ONLY possible replacement host.
+        cluster, topo = _topo_cluster(
+            n_storage=4, topo={"n_dcs": 1, "machines_per_dc": 6}
+        )
+
+        async def main():
+            m1, m2 = topo.machines[1], topo.machines[2]
+            assert 1 in m1.log_ids
+            # The only replacement candidate is dark too: no candidate.
+            m2.alive = False
+            m1.alive = False
+            cluster.log_system.logs[1].reachable = False
+            await loop.delay(
+                topo.registry.lease_timeout * 2.5
+            )  # both leases lapse
+            with pytest.raises(RecruitmentStalled):
+                topo._replace_dead_logs()
+            assert "log" in topo.registry.stalls
+            d = topo.registry.status()["stall_details"]["log"]
+            assert d["candidates"] == 0
+            assert "log" in d["awaiting"]
+            # The spare machine registers (restore == registration):
+            # the replacement now lands on it and the stall drains.
+            topo.restore_machine(m2)
+            topo._replace_dead_logs()
+            assert "log" not in topo.registry.stalls
+            assert topo._log_home(1) is m2
+            assert cluster.log_system.logs[1].reachable
+            cluster.stop()
+
+        loop.run(main(), timeout_sim_seconds=120)
+    loop.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# move-machine (the composed drain verb)
+# ---------------------------------------------------------------------------
+
+def test_cli_move_machine_drains_and_retires():
+    """`cli.py --topology` + `move-machine m0`: storage excluded and
+    team-drained, logs demoted with the live copy as donor (zero
+    acked-write loss), machine retired role-free — all verified through
+    the shell and status json."""
+    from foundationdb_tpu.cli import Cli
+
+    cli = Cli(topology=True)
+    try:
+        topo = cli.cluster.sim_topology
+        m0 = topo.machines[0]
+        assert m0.storage_tags == [0] and m0.log_ids == [0]
+        cli.execute("writemode on")
+        for i in range(20):
+            assert cli.execute(f"set k{i} v{i}") == "Committed"
+        out = cli.execute("move-machine m0")
+        assert "drained and retired" in out, out
+        st = json.loads(cli.execute("status json"))
+        machines = {m["machine"]: m for m in st["cluster"]["machines"]}
+        assert machines["m0"]["retired"]
+        assert not machines["m0"]["storage_tags"]
+        assert not machines["m0"]["logs"] and not machines["m0"]["txn"]
+        assert 0 in st["cluster"]["configuration"]["excluded_servers"]
+        # Zero acked-write loss across the drain.
+        for i in range(20):
+            assert f"v{i}" in cli.execute(f"get k{i}")
+        assert cli.execute("set after move") == "Committed"
+        assert "move" in cli.execute("get after")
+        # A retired machine is terminal: never killed, restored or
+        # placed again.
+        assert m0 not in topo.killable_machines()
+        topo.restore_machine(m0)
+        assert m0.retired
+        # move-machine refuses protected (coordinator) machines.
+        prot = next(m for m in topo.machines if m.protected)
+        assert "ERROR" in cli.execute(f"move-machine {prot.name}")
+    finally:
+        cli.close()
+
+
+def test_chaos_recruitment_spec_targeted_kills():
+    """The extended chaos spec: permanent log-host (and when the deck
+    allows, storage-host) kills under DD, green and deterministic."""
+    from foundationdb_tpu.workloads.tester import run_spec
+
+    with open(os.path.join(ROOT, "specs", "chaos_recruitment.json")) as f:
+        spec = json.load(f)
+    assert spec["sev_error_allowlist"] == ["LogReplacementWindowLost"]
+    a = run_spec(spec)
+    assert a["ok"], a
+    m = a["MachineAttrition"]["metrics"]
+    assert m["permanent_log_kills"] + m["permanent_storage_kills"] \
+        + m["permanent_kills"] >= 2, m
+    b = run_spec(spec)
+    assert b["fingerprint"] == a["fingerprint"]
+
+
+# ---------------------------------------------------------------------------
+# multiprocess (slow): controller failover
+# ---------------------------------------------------------------------------
+
+def _free_ports(n):
+    import socket
+
+    socks = [socket.socket() for _ in range(n)]
+    try:
+        for s in socks:
+            s.bind(("127.0.0.1", 0))
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
+def _write_spec(tmp_path, classes, machines=None, spec_extra=None):
+    from foundationdb_tpu.cluster.multiprocess import write_cluster_file
+
+    cf = str(tmp_path / "cluster.json")
+    ports = _free_ports(len(classes))
+    spec = {
+        "n_storage": 4, "n_logs": 2, "replication": "double",
+        "shard_boundaries": ["m"], "engine": "memory", "seed": 1,
+        **(spec_extra or {}),
+        "ports": dict(zip(classes, ports)),
+    }
+    if machines:
+        spec["machines"] = machines
+    write_cluster_file(cf, {"spec": spec})
+    return cf
+
+
+def _spawn_machine(cf, tmp_path, machine_id):
+    return subprocess.Popen(
+        [sys.executable, "-m", "foundationdb_tpu.server", "-r", "fdbd",
+         "-m", machine_id, "-C", cf,
+         "-d", str(tmp_path / "mach" / machine_id)],
+        cwd=ROOT, stderr=subprocess.PIPE, text=True,
+        start_new_session=True,
+    )
+
+
+def _teardown(procs):
+    for p in procs:
+        try:
+            os.killpg(os.getpgid(p.pid), signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            pass
+    for p in procs:
+        try:
+            p.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            p.wait(timeout=10)
+
+
+def _wait_keys(cf, keys, procs, deadline_s=120):
+    from foundationdb_tpu.cluster.multiprocess import read_cluster_file
+
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        info = read_cluster_file(cf) or {}
+        if all(k in info for k in keys):
+            return info
+        for p in procs:
+            if p.poll() is not None:
+                raise RuntimeError(
+                    f"host died rc={p.returncode}: "
+                    f"{p.stderr.read()[-2000:]}"
+                )
+        time.sleep(0.1)
+    raise RuntimeError(f"cluster keys {keys} never appeared")
+
+
+@pytest.mark.slow
+def test_controller_machine_kill_failover_drains_stall(tmp_path):
+    """THE controller-failover acceptance: the controller's machine
+    group is SIGKILLed while a recruiting_resolver stall is in flight —
+    the standby candidate on another machine takes the seat over the
+    shared coordination quorum, workers re-register against the new
+    controller address, the SAME stall is visible there, and it drains
+    (commits flow) once a spare resolver machine registers."""
+    from foundationdb_tpu.cli import Cli
+    from foundationdb_tpu.cluster.multiprocess import read_cluster_file
+
+    classes = ("log", "storage", "txn0", "txn1", "resolver0", "resolver1")
+    machines = {
+        "m0": ["txn0"],
+        "m1": ["log", "storage"],
+        "m2": ["txn1"],
+        "m3": ["resolver0"],
+        "m4": ["resolver1"],
+    }
+    cf = _write_spec(
+        tmp_path, classes, machines=machines,
+        spec_extra={"n_resolvers": 1,
+                    "coordination_dir": str(tmp_path / "coords")},
+    )
+    m0 = _spawn_machine(cf, tmp_path, "m0")
+    m1 = _spawn_machine(cf, tmp_path, "m1")
+    m3 = _spawn_machine(cf, tmp_path, "m3")
+    procs = [m0, m1, m3]
+    try:
+        info = _wait_keys(cf, ("log", "storage", "resolver0", "txn",
+                               "controller"), procs, deadline_s=150)
+        first_controller = info["controller"]
+        cli = Cli(cluster_file=cf)
+        try:
+            cli.execute("writemode on")
+            assert cli.execute("set before failover") == "Committed"
+
+            # Standby candidate joins (txn1 on m2): parks on the lease.
+            m2 = _spawn_machine(cf, tmp_path, "m2")
+            procs.append(m2)
+            _wait_keys(cf, ("txn1",), procs)
+
+            # Kill the resolver machine: an in-flight stall appears.
+            os.killpg(os.getpgid(m3.pid), signal.SIGKILL)
+            m3.wait(timeout=20)
+            deadline = time.time() + 90
+            stalled = False
+            while time.time() < deadline:
+                st = json.loads(cli.execute("status json"))
+                if "resolver" in st["cluster"]["recruitment"]["stalls"]:
+                    stalled = True
+                    break
+                time.sleep(0.5)
+            assert stalled, "resolver stall never surfaced"
+
+            # Kill the CONTROLLER's machine group with the stall in
+            # flight: the standby takes the seat.
+            os.killpg(os.getpgid(m0.pid), signal.SIGKILL)
+            m0.wait(timeout=20)
+            deadline = time.time() + 90
+            took_over = False
+            while time.time() < deadline:
+                info = read_cluster_file(cf) or {}
+                if info.get("controller") not in (None, first_controller):
+                    took_over = True
+                    break
+                time.sleep(0.5)
+            assert took_over, "no candidate took the controller seat"
+
+            # The shell follows the controller key: the registry is
+            # REBUILT from re-registrations (log+storage re-appear) and
+            # the in-flight stall is visible under the new seat.
+            deadline = time.time() + 90
+            rebuilt = False
+            while time.time() < deadline:
+                st = json.loads(cli.execute("status json"))
+                rec = st["cluster"]["recruitment"]
+                classes_seen = {w["class"] for w in rec["workers"]
+                                if w["live"]}
+                if {"log", "storage"} <= classes_seen \
+                        and "resolver" in rec["stalls"]:
+                    rebuilt = True
+                    break
+                time.sleep(0.5)
+            assert rebuilt, f"registry never rebuilt: {rec}"
+            d = rec.get("stall_details", {}).get("resolver", {})
+            assert d.get("awaiting"), d
+
+            # The spare resolver machine registers: the stall drains
+            # UNDER THE NEW CONTROLLER and commits flow again.
+            m4 = _spawn_machine(cf, tmp_path, "m4")
+            procs.append(m4)
+            deadline = time.time() + 120
+            drained = False
+            while time.time() < deadline:
+                st = json.loads(cli.execute("status json"))
+                if st["cluster"]["recovery_state"]["name"] \
+                        == "fully_recovered" \
+                        and not st["cluster"]["recruitment"]["stalls"]:
+                    drained = True
+                    break
+                time.sleep(0.5)
+            assert drained, "stall never drained under the new controller"
+        finally:
+            cli.close()
+
+        # Data plane: a FRESH shell (the txn alias re-pointed at the new
+        # leader) commits, and pre-failover data survived.
+        cli2 = Cli(cluster_file=cf)
+        try:
+            cli2.execute("writemode on")
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                if cli2.execute("set after failover") == "Committed":
+                    break
+                time.sleep(0.5)
+            assert "failover" in cli2.execute("get after")
+            assert "failover" in cli2.execute("get before")
+        finally:
+            cli2.close()
+    finally:
+        _teardown(procs)

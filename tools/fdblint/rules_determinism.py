@@ -252,7 +252,12 @@ def _check_set_order(ctx: FileCtx) -> list[Finding]:
 # (det-recruit-order).
 
 _RECRUIT_SUFFIX = "cluster/recruitment.py"
-_RECRUIT_ANCHOR = "select_workers"
+# Every shared placement entry point the sim tier must route through:
+# the general ranker AND the durable-role replacement ranker (log/storage
+# re-recruitment, machine drains). Each anchor DEFINED in the recruitment
+# module must be reachable from a sim_loop root, or that placement path
+# has silently unwired from the shared code and the tiers can diverge.
+_RECRUIT_ANCHORS = ("select_workers", "select_replacement_hosts")
 
 
 def check_project(ctxs: list[FileCtx]) -> list[Finding]:
@@ -266,19 +271,20 @@ def check_project(ctxs: list[FileCtx]) -> list[Finding]:
     return out
 
 
-def _anchor_def(ctx: FileCtx) -> Optional[ast.AST]:
+def _anchor_defs(ctx: FileCtx) -> list[tuple[str, ast.AST]]:
+    out = []
     for node in ctx.tree.body:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
-                and node.name == _RECRUIT_ANCHOR:
-            return node
-    return None
+                and node.name in _RECRUIT_ANCHORS:
+            out.append((node.name, node))
+    return out
 
 
 def _check_recruit_reach(ctxs, recruit_ctxs) -> list[Finding]:
     from .rules_jax import _Project
 
-    anchors = [(c, _anchor_def(c)) for c in recruit_ctxs]
-    anchors = [(c, n) for c, n in anchors if n is not None]
+    anchors = [(c, name, node) for c in recruit_ctxs
+               for name, node in _anchor_defs(c)]
     if not anchors:
         return []  # no ranker defined: nothing to wire
     project = _Project(ctxs)
@@ -288,18 +294,19 @@ def _check_recruit_reach(ctxs, recruit_ctxs) -> list[Finding]:
         # fixtures without a harness): reachability is unjudgeable.
         return []
     reachable = _reachable(project, roots)
-    for ctx, node in anchors:
-        hit = any(fi.name == _RECRUIT_ANCHOR
+    out: list[Finding] = []
+    for ctx, name, node in anchors:
+        hit = any(fi.name == name
                   and fi.ctx.path.endswith(_RECRUIT_SUFFIX)
                   for fi in reachable)
         if not hit:
-            return [Finding(
+            out.append(Finding(
                 ctx.path, node.lineno, "det-recruit-reach",
-                f"{_RECRUIT_ANCHOR}() is not reachable from any sim_loop "
+                f"{name}() is not reachable from any sim_loop "
                 "root: the sim tier's placement no longer routes through "
                 "the shared recruitment ranker (tiers can diverge)",
-                end_line=node.lineno)]
-    return []
+                end_line=node.lineno))
+    return out
 
 
 def _sim_loop_roots(project) -> list:

@@ -76,11 +76,15 @@ def regions_spec(seed: int) -> dict:
 
 def recruitment_spec(seed: int) -> dict:
     """Per-seed variation of the recruitment chaos base
-    (specs/chaos_recruitment.json: PERMANENT machine kills under the
-    fitness-ranked re-placement path): randomized recruitment knobs —
-    heartbeat cadence, lease horizon, stall-retry delay — plus the
-    kill/permanent-kill mix. Deterministic per seed; the printed spec IS
-    the repro."""
+    (specs/chaos_recruitment.json: PERMANENT machine kills — including
+    kills TARGETED at log- and storage-hosting machines, the durable-role
+    re-recruitment paths — under the fitness-ranked re-placement path):
+    randomized recruitment knobs — heartbeat cadence, lease horizon,
+    stall-retry and rollback-retry delays — plus the kill mix.
+    Deterministic per seed; the printed spec IS the repro. The base
+    spec's `sev_error_allowlist` names the events a kill beyond the
+    replication budget may legitimately raise (LogReplacementWindowLost);
+    anything else still fails the seed."""
     import random
 
     base_path = os.path.join(os.path.dirname(os.path.dirname(
@@ -102,9 +106,18 @@ def recruitment_spec(seed: int) -> dict:
         knobs["server:RECRUITMENT_STALL_RETRY_DELAY"] = round(
             0.05 + rng.random() * 0.95, 4
         )
+    if rng.random() < 0.7:
+        knobs["server:STORAGE_ROLLBACK_RETRY_DELAY"] = round(
+            0.05 + rng.random() * 0.45, 4
+        )
     for w in spec["workloads"]:
         if w["name"] == "MachineAttrition":
-            w["permanent_kills"] = rng.randint(1, 3)
+            w["permanent_kills"] = rng.randint(0, 2)
+            w["permanent_log_kills"] = rng.randint(0, 2)
+            w["permanent_storage_kills"] = rng.randint(0, 2)
+            if not (w["permanent_kills"] + w["permanent_log_kills"]
+                    + w["permanent_storage_kills"]):
+                w["permanent_log_kills"] = 1
             w["kills"] = rng.randint(0, 2)
             w["reboots"] = rng.randint(0, 2)
     return spec
