@@ -25,11 +25,37 @@ import struct
 from .client.database import Database
 from .core.trace import TraceEvent
 
-MAGIC = b"FDBTPUB1"
+MAGIC = b"FDBTPUB1"   # legacy header: magic + i64 snapshot version
+# Versioned header (durable-format lattice, core/serialize.DURABLE_FORMAT):
+# magic + u32 format revision + i64 snapshot version. Readers accept both
+# magics; a B2 stamp outside [min_compatible, current] refuses with the
+# typed IncompatibleProtocolVersion instead of mis-decoding.
+MAGIC2 = b"FDBTPUB2"
 _LEN = struct.Struct("<I")
 # System-space key marking a restore in progress (ref: the reference's
 # restore lock in `\xff` — fdbclient/SystemData restore keys).
 RESTORE_MARKER = b"\xff/restoreInProgress"
+
+
+def read_snapshot_header(f) -> tuple[int, int]:
+    """Read + lattice-check a container header; returns (format_version,
+    snapshot_version). Raises ValueError for a non-container file and
+    IncompatibleProtocolVersion for a stamp outside the lattice (a
+    snapshot written by a newer binary refuses cleanly, never tears)."""
+    from .core.serialize import DURABLE_FORMAT
+
+    magic = f.read(len(MAGIC))
+    if magic == MAGIC:
+        # Unstamped legacy container == durable revision 1.
+        DURABLE_FORMAT.check_durable(1, "snapshot container")
+        (version,) = struct.unpack("<q", f.read(8))
+        return 1, version
+    if magic == MAGIC2:
+        (fv,) = struct.unpack("<I", f.read(4))
+        DURABLE_FORMAT.check_durable(fv, "snapshot container")
+        (version,) = struct.unpack("<q", f.read(8))
+        return fv, version
+    raise ValueError("not a backup container (bad magic)")
 
 
 def _write_rec(f, key: bytes, value: bytes) -> None:
@@ -52,9 +78,11 @@ async def _write_snapshot(out, tr, version: int, begin: bytes, end: bytes,
                           chunk_rows: int) -> int:
     """ONE implementation of the snapshot wire format (header + records),
     shared by the file and container paths; returns rows written."""
+    from .core.serialize import DURABLE_FORMAT
     from .kv.keys import key_after
 
-    out.write(MAGIC + struct.pack("<q", version))
+    out.write(MAGIC2 + struct.pack("<I", DURABLE_FORMAT.stamp())
+              + struct.pack("<q", version))
     rows = 0
     cursor = begin
     while True:
@@ -159,9 +187,7 @@ async def restore(
 
     # fdblint: allow[async-blocking] -- restore streams a host-local container file; same no-sim-disk-model rationale as the snapshot writer above.
     with open(path, "rb") as f:
-        header = f.read(len(MAGIC) + 8)
-        if header[: len(MAGIC)] != MAGIC:
-            raise ValueError(f"{path} is not a backup container")
+        read_snapshot_header(f)  # format-lattice check BEFORE the clear
         await db.transact(begin_body)
         recs = _read_recs(f)
         while True:
@@ -429,9 +455,7 @@ async def restore_to_version(db: Database, url: str, version: int) -> int:
     snap_v = max(snaps)
     blob = container.read_file(container.snapshot_name(snap_v))
     f = io.BytesIO(blob)
-    header = f.read(len(MAGIC) + 8)
-    if header[: len(MAGIC)] != MAGIC:
-        raise ValueError("corrupt snapshot in container")
+    read_snapshot_header(f)  # raises before the multi-txn clear begins
 
     # Same crash-detection protocol as restore(): the multi-transaction
     # clear + apply + replay runs under the restore-in-progress marker,

@@ -42,6 +42,13 @@ _K_ENTRY = 1
 _K_EPOCH = 2
 _K_TRUNC = 3
 _K_POP = 4
+# Durable-format stamp (ref: IncludeVersion on persisted state,
+# flow/serialize.h:195): each incarnation that opens the queue at a new
+# durable revision pushes one FORMAT record (riding the next fsync);
+# recovery lattice-checks every stamp it replays — a stream stamped by a
+# NEWER binary refuses with IncompatibleProtocolVersion before any state
+# is rebuilt, and an unstamped stream is revision 1.
+_K_FORMAT = 5
 
 
 def _enc_entry(prev_version: int, version: int, tms) -> bytes:
@@ -111,8 +118,12 @@ class DurableTaggedTLog(TaggedTLog):
         # add these to the in-memory numbers).
         self._spill_bytes_by_v: dict[int, int] = {}
         self.spilled_bytes = 0
+        # Set by recovery: the stream's durable-format revision (1 for
+        # unstamped legacy streams; refusal happens inside recovery).
+        self.format_version = 1
         self._recover_from_queue(init_version)
         self._maybe_spill()  # bound memory after a large replay too
+        self._stamp_format()
 
     @property
     def spilled_entries(self) -> int:
@@ -133,6 +144,16 @@ class DurableTaggedTLog(TaggedTLog):
         return first
 
     def _recover_from_queue(self, init_version: int) -> None:
+        from ..core.serialize import DURABLE_FORMAT
+
+        if self.queue.recovered and not any(
+            data[0] == _K_FORMAT for _seq, data in self.queue.recovered
+        ):
+            # Unstamped legacy stream == durable revision 1: still goes
+            # through the lattice so a binary whose min_compatible moved
+            # past it refuses instead of replaying a layout it no longer
+            # understands.
+            DURABLE_FORMAT.check_durable(1, f"tlog {self._path_prefix}")
         entries: dict[int, list] = {}
         cur_kind, cur_buf = None, b""
         for _seq, data in self.queue.recovered:
@@ -160,6 +181,10 @@ class DurableTaggedTLog(TaggedTLog):
                 tag, v = r.u32(), r.u64()
                 cur = self._popped_by_tag.get(tag, 0)
                 self._popped_by_tag[tag] = max(cur, v)
+            elif kind == _K_FORMAT:
+                self.format_version = BinaryReader(
+                    payload
+                ).check_durable_format(where=f"tlog {self._path_prefix}")
         self._entries = sorted(entries.items())
         self._recount_mem()
         top = self._entries[-1][0] if self._entries else init_version
@@ -186,6 +211,17 @@ class DurableTaggedTLog(TaggedTLog):
             ).detail("Version", self.version.get()).detail(
                 "Epoch", self.locked_epoch
             ).detail("Popped", self.popped).log()
+
+    def _stamp_format(self) -> None:
+        """Mark the stream with this binary's durable revision (rides the
+        next commit's fsync — a lost stamp only keeps the old floor)."""
+        from ..core.serialize import DURABLE_FORMAT
+
+        if self.format_version != DURABLE_FORMAT.current:
+            w = BinaryWriter()
+            w.write_durable_format()
+            self._push_blob(_K_FORMAT, w.to_bytes())
+            self.format_version = DURABLE_FORMAT.current
 
     # -- lifecycle --
     def start(self) -> None:

@@ -358,7 +358,7 @@ class RecoverableShardedCluster:
     """
 
     def __init__(self, conflict_set_factory=None, n_coordinators: int = 3,
-                 **sharded_kw):
+                 coordinators=None, **sharded_kw):
         from .sharded_cluster import ShardedKVCluster
 
         self.conflict_set_factory = conflict_set_factory or (
@@ -372,7 +372,13 @@ class RecoverableShardedCluster:
             # registers stay in-memory — they model a separate, protected
             # failure domain there (sim2's protectedAddresses).
             datadir = None
-        if datadir is not None:
+        if coordinators is not None:
+            # Pre-built register servers (the power-loss restart runner
+            # carries them across incarnations: the quorum is a separate,
+            # protected failure domain, same model as the os_layer note
+            # above — the generation fence must survive the reboot).
+            self.coordinators = list(coordinators)
+        elif datadir is not None:
             # Durable coordinators ride the same datadir: the generation
             # counter and its fencing promises must survive a process kill
             # (a cold boot IS a recovery — it bumps the durable generation

@@ -264,6 +264,15 @@ def multiprocess_status(host) -> dict[str, Any]:
                 }
             },
             "recruitment": host._recruitment_status(),
+            # Protocol-skew visibility (the typed 1109 path): a mixed-
+            # version fleet shows up HERE instead of as a silent
+            # reconnect loop in the logs.
+            "incompatible_connections": getattr(
+                host.transport, "incompatible_connections", 0
+            ),
+            "incompatible_peers": dict(getattr(
+                host.transport, "incompatible_peers", {}
+            )),
             "configuration": {
                 "logs": host.n_logs,
                 "storage_servers": host.n_storage,

@@ -67,6 +67,7 @@ BrokenPromise = _define("BrokenPromise", 1100, "The promise was dropped before b
 ActorCancelled = _define("ActorCancelled", 1101, "Asynchronous operation cancelled")
 RequestMaybeDelivered = _define("RequestMaybeDelivered", 1030, "Request may or may not have been delivered")
 ConnectionFailed = _define("ConnectionFailed", 1026, "Network connection failed")
+IncompatibleProtocolVersion = _define("IncompatibleProtocolVersion", 1109, "Incompatible protocol version (peer or durable format outside the compatibility lattice)")
 CoordinatorsChanged = _define("CoordinatorsChanged", 1027, "Coordination servers have changed")
 MasterRecoveryFailed = _define("MasterRecoveryFailed", 1203, "Master recovery failed")
 WorkerRemoved = _define("WorkerRemoved", 1202, "Normal worker shut down")

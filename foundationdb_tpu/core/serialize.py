@@ -31,10 +31,93 @@ import struct
 from enum import IntEnum
 from typing import Any
 
-# Protocol version: bumped on any wire-format change (ref:
-# currentProtocolVersion, flow/serialize.h:188). High bits spell the
-# project; low byte is the revision.
-PROTOCOL_VERSION = 0x0FDB_70_0001
+# Protocol version LATTICE: `current` is bumped on any wire-format
+# change; `min_compatible` names the oldest revision this binary still
+# reads (ref: currentProtocolVersion + minCompatibleProtocolVersion,
+# flow/serialize.h:188-195 and ProtocolVersion.h). High bits spell the
+# project; low byte is the revision. Rev 0002 added the format lattice
+# itself (durable format stamps + the versioned ConnectPacket); rev 0001
+# streams are still accepted.
+PROTOCOL_VERSION = 0x0FDB_70_0002
+MIN_COMPATIBLE_PROTOCOL_VERSION = 0x0FDB_70_0001
+
+
+class FormatLattice:
+    """A `current`/`min_compatible` version pair with stamp/check.
+
+    Two instances govern the two format families:
+
+    - WIRE_FORMAT: what `write_protocol_version` stamps at the head of
+      every message/connection; readers accept same-major peers whose
+      revision is at least `min_compatible` (a NEWER same-major peer is
+      accepted — it promises read-compat down to its own min, exactly
+      the reference's same-release compatibility window).
+    - DURABLE_FORMAT: small-integer revision stamped into durable
+      streams (DiskQueue record streams of the tlog and memory engine,
+      snapshot containers). Readers accept [min_compatible, current]
+      ONLY: a stamp NEWER than `current` is a downgrade and must refuse
+      cleanly — an older binary cannot know a future layout.
+    """
+
+    __slots__ = ("kind", "current", "min_compatible")
+
+    def __init__(self, kind: str, current: int, min_compatible: int):
+        self.kind = kind
+        self.current = current
+        self.min_compatible = min_compatible
+
+    def stamp(self) -> int:
+        return self.current
+
+    def check_durable(self, v: int, where: str = "") -> int:
+        if not (self.min_compatible <= v <= self.current):
+            from .errors import IncompatibleProtocolVersion
+
+            raise IncompatibleProtocolVersion(
+                f"{where or self.kind} format {v:#x} outside "
+                f"[{self.min_compatible:#x}, {self.current:#x}] "
+                + ("(written by a newer binary: refuse, do not corrupt)"
+                   if v > self.current else "(older than min_compatible)")
+            )
+        return v
+
+    def check_wire(self, v: int, where: str = "") -> int:
+        # Same major wire revision (all but the low byte), and not older
+        # than the compatibility floor. Newer same-major peers pass.
+        if (v >> 8) != (self.current >> 8) or v < self.min_compatible:
+            from .errors import IncompatibleProtocolVersion
+
+            raise IncompatibleProtocolVersion(
+                f"peer protocol {v:#x} vs local {self.current:#x} "
+                f"(min compatible {self.min_compatible:#x})"
+                + (f" at {where}" if where else "")
+            )
+        return v
+
+
+WIRE_FORMAT = FormatLattice(
+    "wire", PROTOCOL_VERSION, MIN_COMPATIBLE_PROTOCOL_VERSION
+)
+# Durable layout revision (small integer, stamped into record streams and
+# container headers — the DiskQueue PAGE layout itself is versioned by
+# its magic). Rev 1 = unstamped legacy streams; rev 2 = stamped streams.
+DURABLE_FORMAT = FormatLattice("durable", 2, 1)
+
+
+def durable_format_override(version: int):
+    """Run with the durable lattice at `version` (min_compatible follows
+    one revision back — readers accept version-N-1 layouts). Returns an
+    undo callable; the upgrade restart runner applies this per phase so
+    phase 2 can boot 'a newer binary' (or, for the downgrade-refusal
+    spec, an older one) over phase 1's durable state."""
+    saved = (DURABLE_FORMAT.current, DURABLE_FORMAT.min_compatible)
+    DURABLE_FORMAT.current = version
+    DURABLE_FORMAT.min_compatible = max(1, version - 1)
+
+    def undo():
+        DURABLE_FORMAT.current, DURABLE_FORMAT.min_compatible = saved
+
+    return undo
 
 
 # -- crc32c (Castagnoli, reflected poly 0x82F63B78) --
@@ -76,7 +159,15 @@ class BinaryWriter:
         self._parts: list[bytes] = []
 
     def write_protocol_version(self) -> "BinaryWriter":
-        return self.u64(PROTOCOL_VERSION)
+        """The ONE place wire streams are stamped (the fdblint
+        wire-raw-protocol-version rule keeps every format on this
+        negotiated path)."""
+        return self.u64(WIRE_FORMAT.stamp())
+
+    def write_durable_format(self) -> "BinaryWriter":
+        """Stamp a durable record stream with the current durable-layout
+        revision (ref: IncludeVersion on persisted state)."""
+        return self.u32(DURABLE_FORMAT.stamp())
 
     def raw(self, b: bytes) -> "BinaryWriter":
         self._parts.append(b)
@@ -108,8 +199,16 @@ class BinaryWriter:
         return b"".join(self._parts)
 
 
-class ProtocolVersionMismatch(Exception):
-    pass
+def _protocol_mismatch_alias():
+    from .errors import IncompatibleProtocolVersion
+
+    return IncompatibleProtocolVersion
+
+
+# Back-compat name: the bare exception this module used to raise is now
+# the typed FdbError (code 1109) so the codec, the transport and status
+# json all speak the same error.
+ProtocolVersionMismatch = _protocol_mismatch_alias()
 
 
 class BinaryReader:
@@ -120,14 +219,16 @@ class BinaryReader:
         self._pos = 0
 
     def check_protocol_version(self) -> int:
-        """(ref: IncludeVersion, flow/serialize.h:195-210). Compatibility
-        rule: same major wire revision (all but the low byte) is accepted."""
-        v = self.u64()
-        if (v >> 8) != (PROTOCOL_VERSION >> 8):
-            raise ProtocolVersionMismatch(
-                f"peer protocol {v:#x} vs local {PROTOCOL_VERSION:#x}"
-            )
-        return v
+        """(ref: IncludeVersion, flow/serialize.h:195-210). Lattice rule:
+        same major wire revision AND at least MIN_COMPATIBLE — raises the
+        typed IncompatibleProtocolVersion (1109) otherwise."""
+        return WIRE_FORMAT.check_wire(self.u64())
+
+    def check_durable_format(self, where: str = "") -> int:
+        """Read + lattice-check a durable stream stamp: accepts
+        [min_compatible, current]; refuses newer stamps cleanly (the
+        downgrade-refusal contract — never decode a future layout)."""
+        return DURABLE_FORMAT.check_durable(self.u32(), where)
 
     def raw(self, n: int) -> bytes:
         if self._pos + n > len(self._buf):

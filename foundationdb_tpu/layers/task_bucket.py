@@ -52,9 +52,19 @@ def _unpack_params(raw: bytes) -> dict:
 
 
 class TaskBucket:
-    def __init__(self, subspace: Subspace):
+    def __init__(self, subspace: Subspace,
+                 timeout_versions: Optional[int] = None):
         self.available = subspace[b"available"]
         self.timeouts = subspace[b"timeouts"]
+        # Per-bucket lease horizon override (ref: TaskBucket::setTimeout);
+        # None = the global knob.
+        self._timeout_versions = timeout_versions
+
+    @property
+    def timeout_versions(self) -> int:
+        return (self._timeout_versions
+                if self._timeout_versions is not None
+                else SERVER_KNOBS.TASKBUCKET_TIMEOUT_VERSIONS)
 
     # -- producer side --
     def add(self, tr, params: dict, priority: int = 0) -> bytes:
@@ -92,10 +102,7 @@ class TaskBucket:
         if taken is None:
             return None  # raced: claimed+finished under us; caller retries
         priority, task_id = self.available.unpack(k)
-        lease = (
-            await tr.get_read_version()
-            + SERVER_KNOBS.TASKBUCKET_TIMEOUT_VERSIONS
-        )
+        lease = await tr.get_read_version() + self.timeout_versions
         tr.clear(k)
         tr.set(self.timeouts.pack((lease, task_id, priority)), v)
         return Task(task_id, priority, _unpack_params(v), lease)
@@ -114,10 +121,7 @@ class TaskBucket:
         raw = await tr.get(old_key)
         if raw is None:
             raise KeyError("lease lost (timed out and reclaimed)")
-        new_lease = (
-            await tr.get_read_version()
-            + SERVER_KNOBS.TASKBUCKET_TIMEOUT_VERSIONS
-        )
+        new_lease = await tr.get_read_version() + self.timeout_versions
         tr.clear(old_key)
         tr.set(self.timeouts.pack((new_lease, task.id, task.priority)), raw)
         return Task(task.id, task.priority, task.params, new_lease)
@@ -147,8 +151,23 @@ class TaskBucket:
                         stop_when_empty: bool = False):
         """Claim-execute-finish forever (or until drained). `executor` is
         `async (db, task) -> None`; raising leaves the task leased, to be
-        retried after the lease expires — at-least-once."""
+        retried after the lease expires — at-least-once.
+
+        While the executor runs, the lease is renewed at HALF the lease
+        horizon (ref: TaskBucket.actor.cpp extendTimeoutRepeatedly): a
+        long task is never stolen mid-execution, yet the agent dying at
+        ANY instant — including between the claim and the first
+        extension — leaves a lease that expires within one
+        TASKBUCKET_TIMEOUT of the last renewal, so the task is
+        reclaimable by the next sweep. Without the extender, any task
+        outliving its claim lease was silently stolen and re-executed
+        concurrently."""
+        from ..core.actors import ActorCollection
+
         loop = current_loop()
+        extend_interval = (
+            self.timeout_versions / SERVER_KNOBS.VERSIONS_PER_SECOND
+        ) / 2
         while True:
             async def claim(tr):
                 await self.sweep_timeouts(tr)
@@ -166,7 +185,32 @@ class TaskBucket:
                     poll_interval * (0.7 + 0.6 * loop.random.random01())
                 )
                 continue
-            await executor(db, task)
+
+            async def extender(task=task):
+                while True:
+                    await loop.delay(extend_interval)
+
+                    async def ext(tr):
+                        return await self.extend(tr, task)
+
+                    try:
+                        renewed = await db.transact(ext)
+                    except KeyError:
+                        # Lease gone: swept + (possibly) re-claimed by
+                        # another agent. Stop renewing; at-least-once
+                        # covers the double execution, and our finish
+                        # below clears a dead key (a no-op).
+                        return
+                    task.lease_version = renewed.lease_version
+
+            ext_tasks = ActorCollection()
+            from ..core.runtime import spawn
+
+            ext_tasks.add(spawn(extender(), name="taskExtend"))
+            try:
+                await executor(db, task)
+            finally:
+                ext_tasks.cancel_all()
 
             async def fin(tr):
                 self.finish(tr, task)

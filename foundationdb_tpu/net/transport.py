@@ -42,7 +42,7 @@ from ..core.serialize import (
     BinaryReader,
     BinaryWriter,
     ProtocolVersionMismatch,
-    PROTOCOL_VERSION,
+    WIRE_FORMAT,
     crc32c,
     decode_value,
     encode_value,
@@ -83,9 +83,10 @@ class _Connection:
         if not self._sent_connect:
             self._sent_connect = True
             w = BinaryWriter()
-            w.raw(b"FDBTPU\x00\x01").u64(PROTOCOL_VERSION).string(
-                self.transport.local_address
-            )
+            w.raw(b"FDBTPU\x00\x01")
+            # Negotiated path ONLY: the lattice's current revision, never
+            # a raw PROTOCOL_VERSION literal (fdblint enforces this).
+            w.write_protocol_version().string(self.transport.local_address)
             self._wbuf += _frame(w.to_bytes())
         self._wbuf += _frame(payload)
         self._flush()
@@ -160,13 +161,20 @@ class _Connection:
             self.close("bad connect magic")
             return False
         try:
-            ver = r.u64()
-            if (ver >> 8) != (PROTOCOL_VERSION >> 8):
-                raise ProtocolVersionMismatch(hex(ver))
+            ver = WIRE_FORMAT.check_wire(
+                r.u64(), where=self.peer_addr or self.peer_hint
+            )
         except ProtocolVersionMismatch as e:
+            # Typed (1109) + COUNTED per connection: operators see skew
+            # in status json instead of a silent reconnect loop.
+            peer = self.peer_addr or self.peer_hint
+            self.transport.incompatible_connections += 1
+            self.transport.incompatible_peers[peer] = (
+                self.transport.incompatible_peers.get(peer, 0) + 1
+            )
             TraceEvent("ConnectionRejected", severity=30).detail(
                 "Reason", "IncompatibleProtocolVersion"
-            ).detail("Peer", str(e)).log()
+            ).detail("Peer", peer).detail("Error", str(e)).log()
             self.close("protocol mismatch")
             return False
         self.peer_addr = r.string()
@@ -259,6 +267,11 @@ class FlowTransport:
         self._next_reply_token = 1 << 32
         self._peers: dict[str, Peer] = {}
         self._conns: list[_Connection] = []
+        # Protocol-skew observability (ref: the reference counting
+        # incompatible connections for status): total rejections plus a
+        # per-peer breakdown, surfaced by multiprocess_status.
+        self.incompatible_connections = 0
+        self.incompatible_peers: dict[str, int] = {}
 
         self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)

@@ -122,6 +122,22 @@ def generate_config(seed: int) -> dict[str, Any]:
     n_storage = rng.randint(3, 6)
     n_logs = rng.randint(1, 3)
     replication = rng.choice(_REPLICATION_FOR[min(n_storage, 3)])
+    # Cluster KIND is a per-seed draw too (ref: SimulatedCluster's
+    # simple/fearless/with-resolvers configuration draws): most seeds
+    # run the recoverable tier (attrition-capable), a minority pin the
+    # plain sharded data plane where the generation machinery is absent
+    # by construction.
+    kind = "recoverable_sharded" if rng.random() < 0.75 else "sharded"
+    # Storage ENGINE + durability draw (ref: SimulationConfig's
+    # storage-engine randomization, SimulatedCluster.actor.cpp:696):
+    # some seeds run the whole chaos mix over a durable datadir — tlogs
+    # on the DiskQueue, engines behind the storage seam — so every
+    # preset exercises the durable formats, not just restart specs.
+    # "auto" datadirs materialize per RUN (fresh tmpdir), keeping the
+    # printed spec reproducible and the determinism rerun independent.
+    engine = None
+    if rng.random() < 0.25:
+        engine = rng.choice(["memory", "memory", "ssd"])
 
     # Machine/DC topology (sim/topology.py), drawn per seed like the
     # reference's machine/datacenter counts (SimulatedCluster's
@@ -130,7 +146,12 @@ def generate_config(seed: int) -> dict[str, Any]:
     # Needs at least as many machines as the replication factor or the
     # policy is unsatisfiable by construction.
     topology = None
-    if rng.random() < 0.5:
+    if rng.random() < 0.5 and kind == "recoverable_sharded" \
+            and engine is None:
+        # The machine nemesis needs the recoverable tier (sim_topology
+        # only attaches there), and the durable draw keeps real files
+        # out of machine-blackout scenarios (power-loss over a durable
+        # fleet is the restart specs' subject).
         n_dcs = rng.choice([1, 1, 2, 3])
         machines_per_dc = rng.randint(2, 4)
         need = {"single": 1, "double": 2, "triple": 3}[replication]
@@ -199,17 +220,53 @@ def generate_config(seed: int) -> dict[str, Any]:
     ]
     rng.shuffle(optional)
     workloads.extend(optional[: rng.randint(1, 3)])
+    # TaskBucket lease-takeover soak: mortal backup agents + a killing
+    # nemesis, any cluster kind.
+    if rng.random() < 0.25:
+        workloads.append({
+            "name": "BackupAttrition",
+            "keys": rng.randint(24, 56),
+            "tasks": rng.randint(4, 10),
+            "agents": rng.randint(2, 4),
+            "kills": rng.randint(1, 4),
+        })
+    # Topology-scoped adversaries: role-aimed kills + first-class
+    # clogging over the machine processes.
+    if topology is not None:
+        if rng.random() < 0.4:
+            workloads.append({
+                "name": "RandomClogging",
+                "clogs": rng.randint(1, 3),
+                "pairs": rng.randint(0, 2),
+                "swizzles": rng.randint(0, 1),
+                "max_clog": round(0.3 + 0.6 * rng.random(), 2),
+                "interval": round(0.3 + 0.5 * rng.random(), 2),
+            })
+        if replication not in ("single", "two_datacenter") \
+                and rng.random() < 0.4:
+            roles = [r for r in ("log", "storage", "txn")
+                     if rng.random() < 0.7] or ["txn"]
+            workloads.append({
+                "name": "TargetedKill", "roles": roles,
+                "interval": round(0.5 + rng.random(), 2),
+            })
     # Movement + distribution faults only where shards exist.
     movers = rng.random() < 0.7
-    attrition = rng.random() < 0.7
+    attrition = kind == "recoverable_sharded" and rng.random() < 0.7
     if movers:
+        # With n_storage == replicas there is exactly ONE policy-valid
+        # team: no move can ever complete, so progress cannot be
+        # required (exposed by the sharded-kind draw, where attrition —
+        # which also waives progress — is never present).
+        can_move = n_storage > {"single": 1, "double": 2,
+                                "triple": 3}.get(replication, n_storage)
         workloads.append({
             "name": "RandomMoveKeys",
             "interval": round(0.2 + rng.random(), 2),
             # Under attrition every move can lose its race with a
             # recovery; progress becomes best-effort, correctness is
             # carried by the concurrent workloads + ConsistencyCheck.
-            "require_progress": not attrition,
+            "require_progress": not attrition and can_move,
         })
         workloads.append({"name": "DataDistribution"})
     if attrition:
@@ -236,13 +293,24 @@ def generate_config(seed: int) -> dict[str, Any]:
         workloads.append({"name": "RebootStorage",
                           "reboots": rng.randint(1, 3),
                           "interval": round(0.4 + rng.random(), 2)})
+    # Exclude-then-verify against DD: needs a distributor (movers draw)
+    # and spare capacity beyond the replication mode's floor.
+    spare = n_storage - {"single": 1, "double": 2,
+                         "triple": 3}.get(replication, n_storage)
+    if movers and not regions and spare >= 1 and rng.random() < 0.3:
+        workloads.append({"name": "RemoveServersSafely",
+                          "excludes": 1,
+                          "hold_time": round(0.5 + rng.random(), 2)})
 
     cluster: dict[str, Any] = {
-        "kind": "recoverable_sharded",
+        "kind": kind,
         "n_storage": n_storage,
         "n_logs": n_logs,
         "replication": replication,
     }
+    if engine is not None:
+        cluster["engine"] = engine
+        cluster["datadir"] = "auto"
     if log_replication != "single":
         cluster["log_replication"] = log_replication
     if regions:

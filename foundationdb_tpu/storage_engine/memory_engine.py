@@ -27,6 +27,12 @@ OP_CLEAR_RANGE = 2
 OP_SNAP_START = 3
 OP_SNAP_ITEM = 4
 OP_SNAP_END = 5
+# Durable-format stamp (core/serialize.DURABLE_FORMAT lattice): written
+# at open and re-written after every snapshot (so the pop of the log
+# prefix never erases it). Recovery lattice-checks every stamp; an
+# unstamped stream is revision 1; a stamp newer than `current` refuses
+# with IncompatibleProtocolVersion before the map is rebuilt.
+OP_FORMAT = 6
 
 _REC = struct.Struct("<BII")  # op, len1, len2
 
@@ -52,7 +58,9 @@ class KeyValueStoreMemory:
         self._keys: list[bytes] = []
         self._map: dict[bytes, bytes] = {}
         self._bytes_since_snapshot = 0
+        self.format_version = 1
         self._recover()
+        self._stamp_format()
 
     # -- IKeyValueStore-style API --
     def get(self, key: bytes) -> Optional[bytes]:
@@ -109,6 +117,14 @@ class KeyValueStoreMemory:
         self.queue.push(rec)
         self._bytes_since_snapshot += len(rec)
 
+    def _stamp_format(self) -> None:
+        from ..core.serialize import DURABLE_FORMAT
+
+        if self.format_version != DURABLE_FORMAT.current:
+            self._log(_rec(OP_FORMAT,
+                           struct.pack("<I", DURABLE_FORMAT.stamp())))
+            self.format_version = DURABLE_FORMAT.current
+
     def _write_snapshot(self) -> None:
         """Dump the full map between SNAP_START/END markers, commit, then
         pop the log prefix that the snapshot supersedes."""
@@ -116,13 +132,33 @@ class KeyValueStoreMemory:
         for k in self._keys:
             self.queue.push(_rec(OP_SNAP_ITEM, k, self._map[k]))
         self.queue.push(_rec(OP_SNAP_END))
+        # Re-stamp AFTER the snapshot: the pop below releases the log
+        # prefix that held the open-time stamp.
+        from ..core.serialize import DURABLE_FORMAT
+
+        self.queue.push(_rec(OP_FORMAT,
+                             struct.pack("<I", DURABLE_FORMAT.stamp())))
         self.queue.commit()
         # Everything strictly before the snapshot start is superseded.
         self.queue.pop(start_seq)
         self._bytes_since_snapshot = 0
 
     def _recover(self) -> None:
+        from ..core.serialize import DURABLE_FORMAT
+
         records = self.queue.recovered
+        # Lattice-check every format stamp FIRST: refusal must precede
+        # any rebuild (and an unstamped non-empty stream is revision 1).
+        stamped = False
+        for _seq, data in records:
+            op, a, _ = _unrec(data)
+            if op == OP_FORMAT:
+                stamped = True
+                self.format_version = DURABLE_FORMAT.check_durable(
+                    struct.unpack("<I", a)[0], "memory engine log"
+                )
+        if records and not stamped:
+            DURABLE_FORMAT.check_durable(1, "memory engine log")
         # Find the last COMPLETE snapshot (START..END with no gap).
         last_start = None
         last_complete = None

@@ -195,7 +195,7 @@ class BackupRestoreWorkload:
         for path in self.images:
             # fdblint: allow[async-blocking] -- check() runs in the tester's validation phase after the workload stops; it inspects finished snapshot container files, not a serving path.
             with open(path, "rb") as f:
-                f.read(len(bk.MAGIC) + 8)  # header: magic + version
+                bk.read_snapshot_header(f)
                 rows = dict(bk._read_recs(f))
             a = rows.get(self.prefix + b"a")
             b = rows.get(self.prefix + b"b")

@@ -40,35 +40,60 @@ class RandomMoveKeysWorkload:
         if self._task is not None:
             await self._task.done
 
-    async def _run(self):
+    async def _try_one_move(self) -> bool:
         loop = current_loop()
         c = self.cluster
+        ranges = [
+            (b, e if e is not None else KEYSPACE_END, team)
+            for b, e, team in c.shard_map.ranges() if team
+        ]
+        if not ranges:
+            return False
+        b, e, old_team = ranges[loop.random.random_int(0, len(ranges))]
+        # Operator exclusions bind EVERY mover, not just DD's healer
+        # (the reference's moveKeys honors excludedServers): found by
+        # RemoveServersSafely's hold audit — this mover used to draw
+        # from ALL replicas and re-placed shards onto a server an
+        # operator had just drained.
+        bad = getattr(c, "excluded", set())
+        pool = [r for r in c.replicas if int(r.id) not in bad]
+        team = c.policy.select_replicas(pool, random=loop.random)
+        if team is None:
+            return False
+        new_team = tuple(sorted(int(r.id) for r in team))
+        if new_team == tuple(old_team):
+            return False
+        try:
+            await move_keys(c, KeyRange(b, e), new_team, self.lock)
+            self.moves_done += 1
+            return True
+        except ActorCancelled:
+            raise
+        except OperationFailed as err:
+            TraceEvent("RandomMoveKeysSkipped", severity=20).error(
+                err
+            ).log()
+            return False
+
+    async def _run(self):
+        loop = current_loop()
         while not self._stopping:
             await loop.delay(self.interval * (0.5 + loop.random.random01()))
             if self._stopping:
                 break
-            ranges = [
-                (b, e if e is not None else KEYSPACE_END, team)
-                for b, e, team in c.shard_map.ranges() if team
-            ]
-            if not ranges:
-                continue
-            b, e, old_team = ranges[loop.random.random_int(0, len(ranges))]
-            team = c.policy.select_replicas(c.replicas, random=loop.random)
-            if team is None:
-                continue
-            new_team = tuple(sorted(int(r.id) for r in team))
-            if new_team == tuple(old_team):
-                continue
-            try:
-                await move_keys(c, KeyRange(b, e), new_team, self.lock)
-                self.moves_done += 1
-            except ActorCancelled:
-                raise
-            except OperationFailed as err:
-                TraceEvent("RandomMoveKeysSkipped", severity=20).error(
-                    err
-                ).log()
+            await self._try_one_move()
+        # Quick foreground workloads can outrun the first interval (or
+        # every timed attempt can draw the same team / lose its race):
+        # when progress is REQUIRED, the stop path still owes one
+        # completed move — the same contract as _AttritionWorkload's
+        # final kill. Bounded: a cluster where no distinct team exists
+        # still exits and fails check() honestly.
+        attempts = 0
+        while (self.require_progress and self.moves_done == 0
+               and attempts < 8):
+            attempts += 1
+            if not await self._try_one_move():
+                await loop.delay(0.05)
 
     require_progress = True  # spec-settable: under heavy attrition, every
     # attempted move can legitimately lose its race with a recovery.

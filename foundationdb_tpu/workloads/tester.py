@@ -328,6 +328,71 @@ async def _run_workloads(cluster, db, spec) -> dict[str, Any]:
             ).start()
             starters.append((rkey, wl.done))
             checkers.append((rkey, wl.check, wl.metrics))
+        elif name == "RemoveServersSafely":
+            # Exclude-then-verify against DD (ref: RemoveServersSafely.
+            # actor.cpp): needs the sharded data plane + a distributor.
+            from .remove_servers_safely import RemoveServersSafelyWorkload
+
+            if not hasattr(cluster, "storages"):
+                raise SpecError("RemoveServersSafely needs a sharded "
+                                "cluster")
+            wl = RemoveServersSafelyWorkload(
+                cluster, db, excludes=w.get("excludes", 1),
+                drain_timeout=w.get("drain_timeout", 45.0),
+                hold_time=w.get("hold_time", 1.0),
+            )
+            starters.append((rkey, spawn(wl.run()).done))
+            checkers.append((rkey, wl.check, wl.metrics))
+        elif name == "TargetedKill":
+            # Role-aimed machine kills (ref: TargetedKill.actor.cpp):
+            # needs the machine fault topology for role placement.
+            from .targeted_kill import TargetedKillWorkload
+
+            topo = getattr(cluster, "sim_topology", None)
+            if topo is None:
+                raise SpecError(
+                    "TargetedKill needs cluster.topology on a "
+                    "recoverable_sharded cluster"
+                )
+            wl = TargetedKillWorkload(
+                topo, roles=w.get("roles", ["log", "storage", "txn"]),
+                interval=w.get("interval", 0.8),
+                outage=w.get("outage", 0.4),
+                name=f"targeted-kill-{rkey}",
+            ).start()
+            starters.append((rkey, wl.done))
+            checkers.append((rkey, wl.check, wl.metrics))
+        elif name == "RandomClogging":
+            # First-class clogging workload over sim/network.py (ref:
+            # RandomClogging.actor.cpp incl. the swizzle).
+            from .random_clogging import RandomCloggingWorkload
+
+            topo = getattr(cluster, "sim_topology", None)
+            if topo is None:
+                raise SpecError(
+                    "RandomClogging needs cluster.topology on a "
+                    "recoverable_sharded cluster"
+                )
+            wl = RandomCloggingWorkload(
+                topo, interval=w.get("interval", 0.5),
+                clogs=w.get("clogs", 2), pairs=w.get("pairs", 1),
+                swizzles=w.get("swizzles", 1),
+                max_clog=w.get("max_clog", 0.8),
+            ).start()
+            starters.append((rkey, wl.done))
+            checkers.append((rkey, wl.check, wl.metrics))
+        elif name == "BackupAttrition":
+            # TaskBucket lease-takeover soak: mortal backup agents under
+            # a killing nemesis must lose no ranges.
+            from .backup_attrition import BackupAttritionWorkload
+
+            wl = BackupAttritionWorkload(
+                db, keys=w.get("keys", 48), tasks=w.get("tasks", 8),
+                agents=w.get("agents", 3), kills=w.get("kills", 3),
+                deadline=w.get("deadline", 40.0),
+            )
+            starters.append((rkey, spawn(wl.run()).done))
+            checkers.append((rkey, wl.check, wl.metrics))
         elif name == "StatusWorkload":
             # Status-schema probe mid-chaos (ref: StatusWorkload.actor.cpp
             # — the document must render AND conform while the fault
@@ -471,9 +536,29 @@ def run_restart_spec(spec: dict) -> dict[str, Any]:
     Spec: {"seed", "buggify", "cluster": {"kind": "restart", "engine",
     "n_storage", ...}, "datadir": path, "phases": [{"workloads": [...]},
     {"workloads": [...]}]}.
+
+    Upgrade seams (ref: the reference's restart tests booting old-format
+    state into new binaries under IncludeVersion, flow/serialize.h:195):
+
+    - a phase may carry "format_version": N — that incarnation runs with
+      the DURABLE format lattice at revision N (readers accept N-1), so
+      phase 2 at a bumped revision is 'the upgraded binary' reading phase
+      1's stamped state bit-for-bit, and a phase at an OLDER revision
+      than the stamps on disk refuses cleanly: the phase records
+      refused_incompatible instead of corrupting, and later phases are
+      skipped (specs/upgrade_cycle.json runs both directions);
+    - a phase may carry "power_loss": true — it ends by POWER LOSS over
+      a simulated disk (sim/nondurable.py page havoc; fsynced state
+      survives, pending state is dropped/kept/corrupted by seeded coin
+      flip) instead of a clean shutdown; the coordinator quorum is
+      carried across incarnations as a separate protected failure
+      domain. Requires the default memory engine.
     """
     import hashlib
     import tempfile
+
+    from ..core.errors import IncompatibleProtocolVersion
+    from ..core.serialize import durable_format_override
 
     ckw = {k: v for k, v in spec.get("cluster", {}).items()
            if k != "kind"}
@@ -483,9 +568,25 @@ def run_restart_spec(spec: dict) -> dict[str, Any]:
             b.encode() if isinstance(b, str) else b
             for b in ckw["shard_boundaries"]
         ]
+    phases = spec.get("phases", [])
+    nondurable = any(p.get("power_loss") for p in phases)
+    osl = None
+    if nondurable:
+        if ckw.get("engine", "memory") != "memory":
+            raise SpecError("power_loss phases need the memory engine "
+                            "(the simulated disk runs the Python tier)")
+        from ..core.rand import DeterministicRandom
+        from ..sim.nondurable import NonDurableOS
+
+        osl = NonDurableOS(
+            DeterministicRandom(spec.get("seed", 1) * 7919 + 13)
+        )
+        ckw["os_layer"] = osl
+    owns_datadir = not spec.get("datadir") and osl is None
     datadir = spec.get("datadir") or tempfile.mkdtemp(prefix="fdbtpu_rs_")
     results: dict[str, Any] = {"datadir": datadir, "phases": []}
     fingerprint: list = [None]
+    carried_coords: list = []  # power-loss runs: the protected quorum
 
     async def _fingerprint(db) -> str:
         async def read_all(tr):
@@ -499,7 +600,7 @@ def run_restart_spec(spec: dict) -> dict[str, Any]:
             h.update(b"%d:%b=%d:%b;" % (len(k), k, len(v), v))
         return h.hexdigest()
 
-    for phase_idx, phase in enumerate(spec.get("phases", [])):
+    for phase_idx, phase in enumerate(phases):
         import gc
 
         from ..core.trace import TraceSink, set_global_sink
@@ -507,15 +608,26 @@ def run_restart_spec(spec: dict) -> dict[str, Any]:
         gc.collect()  # same isolation contract as run_spec
         set_global_sink(TraceSink())
         undo_knobs = _apply_knobs(spec.get("knobs"))
+        # The per-incarnation 'binary version': durable readers/stampers
+        # run at this phase's revision for the phase's whole lifetime.
+        undo_format = (durable_format_override(phase["format_version"])
+                       if phase.get("format_version") else None)
+        power_loss = bool(phase.get("power_loss"))
         loop = sim_loop(seed=spec.get("seed", 1) * 1000 + phase_idx,
                         buggify=spec.get("buggify", False))
+        refused = False
         with loop_context(loop):
             async def main():
                 from ..cluster.recovery import RecoverableShardedCluster
 
+                kw = dict(ckw)
+                if carried_coords:
+                    kw["coordinators"] = carried_coords[0]
                 cluster = RecoverableShardedCluster(
-                    datadir=datadir, **ckw
+                    datadir=datadir, **kw
                 ).start()
+                if osl is not None and not carried_coords:
+                    carried_coords.append(cluster.coordinators)
                 db = cluster.database()
                 carried_ok = True
                 if phase_idx > 0:
@@ -527,27 +639,56 @@ def run_restart_spec(spec: dict) -> dict[str, Any]:
                     cluster, db, {"workloads": phase.get("workloads", [])}
                 )
                 fingerprint[0] = await _fingerprint(db)
-                cluster.stop()
+                if not power_loss:
+                    # Power loss deliberately SKIPS the clean close: no
+                    # final flush, no engine close — the disk keeps only
+                    # what fsyncs covered (the havoc lands below, after
+                    # the loop is torn down).
+                    cluster.stop()
                 res["state_carried"] = carried_ok
                 return res
 
             try:
                 pres = loop.run(main(), timeout_sim_seconds=3600)
+            except IncompatibleProtocolVersion as e:
+                # Downgrade refusal IS the contract: the incarnation
+                # refuses to decode a newer on-disk format and leaves the
+                # state untouched for a correctly-versioned binary.
+                refused = True
+                pres = {"ok": False, "refused_incompatible": True,
+                        "state_carried": False,
+                        "error": f"{type(e).__name__}: {e}"}
             finally:
                 loop.shutdown()
+                if undo_format is not None:
+                    undo_format()
                 undo_knobs()
+        if power_loss and not refused:
+            pres["power_loss"] = osl.kill()  # the page havoc, seeded
         pres["sev_errors"] = global_sink().error_count
         pres["sev_error_events"] = list(global_sink().error_events[:50])
         results["phases"].append(pres)
+        if refused:
+            break  # later phases would boot over state we refused to read
 
     results["ok"] = all(
         p.get("ok") and p.get("state_carried") and not p.get("sev_errors")
         for p in results["phases"]
+    ) and len(results["phases"]) == len(phases)
+    results["refused_incompatible"] = any(
+        p.get("refused_incompatible") for p in results["phases"]
     )
+    results["fingerprint"] = fingerprint[0]  # determinism-sweep contract
     results["sev_errors"] = sum(p["sev_errors"] for p in results["phases"])
     results["sev_error_events"] = [
         e for p in results["phases"] for e in p.get("sev_error_events", [])
     ][:50]
+    if owns_datadir:
+        # Sweep hygiene: a datadir nobody named is a per-run scratch
+        # disk (each rerun cold-boots a fresh one by construction).
+        import shutil
+
+        shutil.rmtree(datadir, ignore_errors=True)
     return results
 
 
@@ -569,13 +710,24 @@ def run_spec(spec: dict) -> dict[str, Any]:
     # Fresh sink per spec: sev_errors must count THIS run only.
     set_global_sink(TraceSink())
     undo_knobs = _apply_knobs(spec.get("knobs"))
+    auto_datadir = None
     loop = sim_loop(seed=spec.get("seed", 1),
                     buggify=spec.get("buggify", False))
     with loop_context(loop):
         async def main():
+            nonlocal auto_datadir
             ckind = spec.get("cluster", {}).get("kind", "local")
             ckw = {k: v for k, v in spec.get("cluster", {}).items()
                    if k != "kind"}
+            if ckw.get("datadir") == "auto":
+                # Engine-randomized configs (sim/config.py) run durably
+                # over a per-RUN tmpdir: the printed spec stays the
+                # repro, and a determinism rerun gets a fresh disk
+                # instead of cold-booting the first run's files.
+                import tempfile
+
+                auto_datadir = tempfile.mkdtemp(prefix="fdbtpu_sim_")
+                ckw["datadir"] = auto_datadir
             if "shard_boundaries" in ckw:
                 # JSON specs carry boundaries as strings (same contract as
                 # the multiprocess cluster file, _spec_kw).
@@ -618,6 +770,10 @@ def run_spec(spec: dict) -> dict[str, Any]:
         finally:
             loop.shutdown()
             undo_knobs()
+            if auto_datadir is not None:
+                import shutil
+
+                shutil.rmtree(auto_datadir, ignore_errors=True)
     # EXACT SevError accounting (TraceSink keeps a trim-immune record):
     # the count can no longer silently shrink on long runs whose event
     # window trimmed, and the events themselves ride the result so

@@ -795,6 +795,50 @@ def test_rules_registry_matches_readme():
 
 
 # ---------------------------------------------------------------------------
+# pack: wire/durable format discipline
+# ---------------------------------------------------------------------------
+
+def test_wire_raw_protocol_version_bad(tmp_path):
+    fs = run_lint(tmp_path, {SIM: """
+        from .core.serialize import (
+            BinaryWriter, PROTOCOL_VERSION, WIRE_FORMAT,
+        )
+        def f(w: BinaryWriter):
+            w.u64(PROTOCOL_VERSION)
+            w.u32(WIRE_FORMAT.current)
+            w.u64(WIRE_FORMAT.stamp())
+    """})
+    assert rules_of(fs) == ["wire-raw-protocol-version"]
+    assert sum(1 for f in fs
+               if f.rule == "wire-raw-protocol-version") == 3
+
+
+def test_wire_raw_protocol_version_good(tmp_path):
+    fs = run_lint(tmp_path, {
+        SIM: """
+            from .core.serialize import BinaryWriter
+            def f(w: BinaryWriter):
+                w.write_protocol_version()
+                w.write_durable_format()
+                w.u64(12345)  # a plain number is not a version stamp
+        """,
+        # The negotiated path itself is exempt.
+        "foundationdb_tpu/core/serialize.py": """
+            PROTOCOL_VERSION = 1
+            def write_protocol_version(w):
+                w.u64(PROTOCOL_VERSION)
+        """,
+        # Tests probe raw streams deliberately; out of scope.
+        "tests/test_x.py": """
+            from foundationdb_tpu.core.serialize import PROTOCOL_VERSION
+            def f(w):
+                w.u64(PROTOCOL_VERSION)
+        """,
+    })
+    assert "wire-raw-protocol-version" not in rules_of(fs)
+
+
+# ---------------------------------------------------------------------------
 # the tier-1 gate: the shipped tree is clean
 # ---------------------------------------------------------------------------
 

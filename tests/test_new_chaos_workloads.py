@@ -1,0 +1,331 @@
+"""The scenario-corpus additions: RemoveServersSafely, TargetedKill,
+RandomClogging, BackupAttrition (refs: the same-named workloads under
+fdbserver/workloads/ + TaskBucket.actor.cpp checkTimeouts).
+
+Per the ROADMAP bar, every workload here demonstrably CATCHES a seeded
+bug: each `*_flags_seeded_bug` test re-introduces the bug the workload
+was built against (DD ignoring exclusions, a broken quorum-safety gate,
+a no-op unclog, a lease sweep that never requeues) and asserts the
+workload turns it into a named failure instead of a silent hang."""
+
+import json
+
+import pytest
+
+from foundationdb_tpu.workloads.tester import run_spec
+
+
+# ---------------------------------------------------------------------------
+# green paths (standalone + under the spec tester)
+# ---------------------------------------------------------------------------
+
+def test_remove_servers_safely_spec():
+    res = run_spec({
+        "seed": 21, "buggify": True,
+        "cluster": {"kind": "recoverable_sharded", "n_storage": 5,
+                    "n_logs": 2, "replication": "double"},
+        "workloads": [
+            {"name": "Cycle", "nodes": 12, "clients": 2, "txns": 12},
+            {"name": "DataDistribution"},
+            {"name": "RemoveServersSafely", "excludes": 1},
+        ],
+    })
+    assert res["ok"], json.dumps(res, default=str)[:2000]
+    assert res["RemoveServersSafely"]["metrics"]["drains"] == 1
+    assert res["sev_errors"] == 0
+
+
+def test_targeted_kill_and_random_clogging_spec():
+    res = run_spec({
+        "seed": 9, "buggify": True,
+        "cluster": {"kind": "recoverable_sharded", "n_storage": 4,
+                    "n_logs": 2, "replication": "double",
+                    "topology": {"n_dcs": 1, "machines_per_dc": 3}},
+        "workloads": [
+            {"name": "Cycle", "nodes": 12, "clients": 2, "txns": 12},
+            {"name": "TargetedKill", "roles": ["log", "storage", "txn"],
+             "interval": 0.6},
+            {"name": "RandomClogging", "clogs": 2, "pairs": 1,
+             "swizzles": 1},
+        ],
+    })
+    assert res["ok"], json.dumps(res, default=str)[:2000]
+    tk = res["TargetedKill"]["metrics"]
+    assert sum(tk["kills_by_role"].values()) >= 1
+    assert tk["unsafe_kills"] == 0
+    rc = res["RandomClogging"]["metrics"]
+    assert rc["clogs"] + rc["swizzles"] >= 1
+    assert res["sev_errors"] == 0
+
+
+def test_backup_attrition_spec():
+    res = run_spec({
+        "seed": 5,
+        "cluster": {"kind": "sharded", "n_storage": 4, "n_logs": 2,
+                    "replication": "double"},
+        "workloads": [{"name": "BackupAttrition", "keys": 40, "tasks": 8,
+                       "agents": 3, "kills": 3}],
+    })
+    assert res["ok"], json.dumps(res, default=str)[:2000]
+    m = res["BackupAttrition"]["metrics"]
+    assert m["ranges"] == 8 and m["kills"] == 3
+
+
+def test_workloads_need_their_cluster_shape():
+    from foundationdb_tpu.workloads.tester import SpecError
+
+    with pytest.raises(SpecError):
+        run_spec({"cluster": {"kind": "local"},
+                  "workloads": [{"name": "RemoveServersSafely"}]})
+    with pytest.raises(SpecError):
+        run_spec({"cluster": {"kind": "recoverable_sharded",
+                              "n_storage": 4, "n_logs": 2,
+                              "replication": "double"},
+                  "workloads": [{"name": "TargetedKill"}]})
+    with pytest.raises(SpecError):
+        run_spec({"cluster": {"kind": "recoverable_sharded",
+                              "n_storage": 4, "n_logs": 2,
+                              "replication": "double"},
+                  "workloads": [{"name": "RandomClogging"}]})
+
+
+# ---------------------------------------------------------------------------
+# each workload catches its seeded bug
+# ---------------------------------------------------------------------------
+
+def test_remove_servers_safely_flags_seeded_bug(sim, monkeypatch):
+    """Seeded bug: DD 'forgets' operator exclusions (placement considers
+    only failure-detector state) — the drain never happens and the
+    workload must name it, not hang."""
+
+    async def main():
+        from foundationdb_tpu.cluster.data_distribution import (
+            DataDistributor,
+        )
+        from foundationdb_tpu.cluster.recovery import (
+            RecoverableShardedCluster,
+        )
+        from foundationdb_tpu.workloads.remove_servers_safely import (
+            RemoveServersSafelyWorkload,
+        )
+
+        monkeypatch.setattr(
+            DataDistributor, "_unplaceable",
+            lambda self: set(self.failed),  # the bug: exclusions ignored
+        )
+        c = RecoverableShardedCluster(n_storage=5, n_logs=2,
+                                      replication="double").start()
+        c.start_data_distribution()
+        wl = RemoveServersSafelyWorkload(c, c.database(), excludes=1,
+                                         drain_timeout=6.0)
+        await wl.run()
+        assert not await wl.check()
+        assert any("not honoring the exclusion" in f for f in wl.failures)
+        c.stop()
+
+    sim.run(main())
+
+
+def test_targeted_kill_flags_seeded_bug(sim, monkeypatch):
+    """Seeded bug: the topology's quorum-safety gate is broken (can_kill
+    always says yes) — the workload's independent audit must flag the
+    unsafe kill that slips through on a single-replication cluster."""
+
+    async def main():
+        from foundationdb_tpu.cluster.recovery import (
+            RecoverableShardedCluster,
+        )
+        from foundationdb_tpu.sim.topology import MachineTopology
+        from foundationdb_tpu.workloads.targeted_kill import (
+            TargetedKillWorkload,
+        )
+
+        c = RecoverableShardedCluster(
+            n_storage=3, n_logs=2, replication="single",
+            shard_boundaries=[b"g", b"t"],  # every tag holds a shard
+            topology={"n_dcs": 1, "machines_per_dc": 3},
+        ).start()
+        topo = MachineTopology(c, n_dcs=1, machines_per_dc=3)
+        c.sim_topology = topo
+        monkeypatch.setattr(topo, "can_kill", lambda machines: True)
+        wl = TargetedKillWorkload(topo, roles=["storage"],
+                                  interval=0.2, outage=0.2).start()
+        await wl.done
+        assert wl.unsafe_kills >= 1, wl.metrics()
+        assert not await wl.check()
+        c.stop()
+
+    sim.run(main())
+
+
+def test_random_clogging_flags_seeded_bug(sim, monkeypatch):
+    """Seeded bug: unclog_process silently no-ops — the swizzle's parked
+    1000-second clogs never lift and the closing audit must flag the
+    residual clog instead of leaving a dead network behind."""
+
+    async def main():
+        from foundationdb_tpu.cluster.recovery import (
+            RecoverableShardedCluster,
+        )
+        from foundationdb_tpu.sim.network import SimNetwork
+        from foundationdb_tpu.sim.topology import MachineTopology
+        from foundationdb_tpu.workloads.random_clogging import (
+            RandomCloggingWorkload,
+        )
+
+        c = RecoverableShardedCluster(
+            n_storage=4, n_logs=2, replication="double",
+            topology={"n_dcs": 1, "machines_per_dc": 3},
+        ).start()
+        topo = MachineTopology(c, n_dcs=1, machines_per_dc=3)
+        c.sim_topology = topo
+        monkeypatch.setattr(SimNetwork, "unclog_process",
+                            lambda self, p: None)  # the bug
+        wl = RandomCloggingWorkload(topo, clogs=0, pairs=0, swizzles=1,
+                                    max_clog=0.3, interval=0.1).start()
+        await wl.done
+        assert not await wl.check()
+        assert any("residual clogs" in f for f in wl.failures)
+        c.stop()
+
+    sim.run(main())
+
+
+def test_backup_attrition_flags_seeded_bug(sim, monkeypatch):
+    """Seeded bug: the lease sweep never requeues expired claims (the
+    exact takeover path TaskBucket exists for) — a killed agent's range
+    parks forever and the soak must fail by deadline with the missing
+    ranges named."""
+
+    async def main():
+        from foundationdb_tpu.cluster.sharded_cluster import (
+            ShardedKVCluster,
+        )
+        from foundationdb_tpu.layers.task_bucket import TaskBucket
+        from foundationdb_tpu.workloads.backup_attrition import (
+            BackupAttritionWorkload,
+        )
+
+        async def broken_sweep(self, tr):
+            return 0  # the bug: expired leases never requeue
+
+        monkeypatch.setattr(TaskBucket, "sweep_timeouts", broken_sweep)
+        c = ShardedKVCluster(n_storage=4, n_logs=2,
+                             replication="double").start()
+        wl = BackupAttritionWorkload(c.database(), keys=24, tasks=6,
+                                     agents=2, kills=2, deadline=10.0)
+        await wl.run()
+        assert not await wl.check()
+        assert any("not taken over" in f or "lost work" in f
+                   for f in wl.failures)
+        c.stop()
+
+    sim.run(main())
+
+
+# ---------------------------------------------------------------------------
+# the TaskBucket lease-extension fix (regression, ref extendTimeoutRepeatedly)
+# ---------------------------------------------------------------------------
+
+def test_agent_death_before_first_extension_reclaims_in_one_timeout(sim):
+    """An agent that claims and dies BEFORE its first extension leaves a
+    lease that expires within one TASKBUCKET_TIMEOUT of the claim."""
+
+    async def main():
+        from foundationdb_tpu.cluster.cluster import LocalCluster
+        from foundationdb_tpu.core import delay, spawn
+        from foundationdb_tpu.layers.subspace import Subspace
+        from foundationdb_tpu.layers.task_bucket import TaskBucket
+
+        c = LocalCluster().start()
+        db = c.database()
+        tb = TaskBucket(Subspace((b"tbx",)), timeout_versions=500_000)
+
+        async def add(tr):
+            tb.add(tr, {b"op": b"x"})
+
+        await db.transact(add)
+
+        async def never_finishes(db_, task):
+            await delay(3600.0)
+
+        agent = spawn(tb.run_agent(db, never_finishes, poll_interval=0.05))
+        await delay(0.2)  # enough to claim, less than extend interval
+        agent.cancel()    # dies between claim and first extension
+
+        # Drive version time past ONE lease horizon (plus slack), then a
+        # sweep must requeue it for a healthy claimant.
+        for _ in range(8):
+            await db.set(b"tick", b"t")
+            await delay(0.1)
+
+        async def sweep_and_claim(tr):
+            await tb.sweep_timeouts(tr)
+            return await tb.get_one(tr)
+
+        task = await db.transact(sweep_and_claim)
+        assert task is not None and task.params == {b"op": b"x"}
+        c.stop()
+
+    sim.run(main())
+
+
+def test_long_running_task_is_not_stolen_while_agent_lives(sim):
+    """The extender renews at TIMEOUT/2: a task running for several
+    lease horizons stays owned — a concurrent sweep+claim finds
+    nothing, so the task cannot be double-executed."""
+
+    async def main():
+        from foundationdb_tpu.cluster.cluster import LocalCluster
+        from foundationdb_tpu.core import delay, spawn
+        from foundationdb_tpu.layers.subspace import Subspace
+        from foundationdb_tpu.layers.task_bucket import TaskBucket
+
+        c = LocalCluster().start()
+        db = c.database()
+        tb = TaskBucket(Subspace((b"tby",)), timeout_versions=400_000)
+
+        async def add(tr):
+            tb.add(tr, {b"op": b"slow"})
+
+        await db.transact(add)
+        executions = []
+
+        async def slow_exec(db_, task):
+            executions.append(1)
+            # ~3 lease horizons of work, with commits driving versions.
+            for _ in range(12):
+                await db_.set(b"tick2", b"t")
+                await delay(0.1)
+
+        agent = spawn(tb.run_agent(db, slow_exec, poll_interval=0.05,
+                                   stop_when_empty=True))
+
+        # A rival sweeping+claiming mid-execution must find nothing.
+        stolen = []
+
+        async def rival():
+            for _ in range(10):
+                await delay(0.12)
+
+                async def sweep_claim(tr):
+                    await tb.sweep_timeouts(tr)
+                    return await tb.get_one(tr)
+
+                t = await db.transact(sweep_claim)
+                if t is not None:
+                    stolen.append(t)
+
+        r = spawn(rival())
+        await agent.done
+        await r.done
+        assert executions == [1], "task double-executed"
+        assert not stolen, "live agent's lease was stolen"
+
+        async def empty(tr):
+            return await tb.is_empty(tr)
+
+        assert await db.transact(empty)
+        c.stop()
+
+    sim.run(main())

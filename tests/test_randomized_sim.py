@@ -36,6 +36,36 @@ def test_config_is_deterministic_and_varied():
     assert knobbed > 20, "knob randomization should usually trigger"
 
 
+def test_config_draws_engine_kind_and_new_workloads():
+    """The per-seed SHAPE randomization (ref: SimulatedCluster's
+    storage-engine + configuration draws): cluster kind, storage
+    engine/durability, and the new adversary workloads must all appear
+    across a modest seed range — and only in shapes that support them."""
+    kinds, engines, names = set(), set(), set()
+    for s in range(80):
+        c = generate_config(s)
+        kinds.add(c["cluster"]["kind"])
+        engines.add(c["cluster"].get("engine"))
+        wnames = {w["name"] for w in c["workloads"]}
+        names |= wnames
+        # Shape constraints the tester enforces must hold by
+        # construction: topology adversaries only with a topology on the
+        # recoverable tier; attrition needs the recoverable tier; a
+        # drawn engine always comes with a datadir.
+        topo = c["cluster"].get("topology")
+        if {"TargetedKill", "RandomClogging", "MachineAttrition"} & wnames:
+            assert topo is not None
+            assert c["cluster"]["kind"] == "recoverable_sharded"
+        if "Attrition" in wnames:
+            assert c["cluster"]["kind"] == "recoverable_sharded"
+        if c["cluster"].get("engine"):
+            assert c["cluster"]["datadir"] == "auto"
+    assert kinds == {"recoverable_sharded", "sharded"}
+    assert {"memory", "ssd"} <= engines
+    assert {"TargetedKill", "RandomClogging", "BackupAttrition",
+            "RemoveServersSafely"} <= names
+
+
 def _run_seeds(tmp_path, seeds, name="spec.json"):
     spec = str(tmp_path / name)
     with open(spec, "w") as f:
@@ -62,3 +92,52 @@ def test_same_seed_reproduces_identical_results(tmp_path):
     b = _run_seeds(tmp_path, [303])
     assert a.returncode == 0, a.stderr[-3000:]
     assert a.stderr == b.stderr, "same seed + hash seed must replay"
+
+
+def test_engine_kind_randomized_sweep_20_seeds_deterministic(capsys):
+    """The ROADMAP scenario-diversity bar: >= 20 engine/cluster-kind-
+    randomized seeds, every one green, every one replaying to the same
+    keyspace fingerprint, repro configs printed (the slow-tier twin of
+    `tools/seed_sweep.py --randomized --seeds 0:20 --check-determinism`).
+
+    On CPU-only hosts, seeds whose knob draw picks the tpu conflict-set
+    are skipped (same rationale as the quick tier's topology-config
+    test: the backend spends tens of minutes in XLA compiles there and
+    has its own differential suite); the next seeds fill in so 20
+    eligible seeds always run.
+    """
+    import jax
+
+    from foundationdb_tpu.workloads.tester import run_spec
+
+    cpu_only = (jax.default_backend() in ("cpu",)
+                and not os.environ.get("FDBTPU_BIG"))
+    eligible = []
+    s = 0
+    while len(eligible) < 20 and s < 200:
+        spec = generate_config(s)
+        if not (cpu_only and spec["knobs"].get(
+                "server:CONFLICT_SET_IMPL") == "tpu"):
+            eligible.append(s)
+        s += 1
+
+    failures = []
+    for seed in eligible:
+        spec = generate_config(seed)
+        print(f"[sweep seed {seed}] kind="
+              f"{spec['cluster']['kind']} engine="
+              f"{spec['cluster'].get('engine', 'memory')} config: "
+              + json.dumps(spec, sort_keys=True))
+        try:
+            a = run_spec(spec)
+            ok = bool(a.get("ok")) and not a.get("sev_errors")
+            if ok:
+                b = run_spec(spec)
+                ok = (a.get("fingerprint") is not None
+                      and a.get("fingerprint") == b.get("fingerprint"))
+        except BaseException as e:  # noqa: BLE001 — report every seed
+            a, ok = {"error": f"{type(e).__name__}: {e}"}, False
+        if not ok:
+            failures.append((seed, a.get("error"),
+                             a.get("sev_error_events", [])[:3]))
+    assert not failures, failures

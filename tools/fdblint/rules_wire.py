@@ -1,0 +1,71 @@
+"""Rule pack — wire/durable format discipline.
+
+``wire-raw-protocol-version``: a ``.u64(PROTOCOL_VERSION)`` (or any
+write-primitive call whose argument resolves to a protocol-version
+constant, or to ``WIRE_FORMAT.current``/``.stamp()``) OUTSIDE
+``core/serialize.py`` writes a raw version stamp that bypasses
+``write_protocol_version``. The negotiated path is the ONE place
+version stamping may happen: it is what the compatibility lattice
+(``core/serialize.WIRE_FORMAT``) overrides, what upgrade restart specs
+exercise, and what keeps every future format readable across a
+version-skewed fleet. A raw ``u64`` write freezes the literal into a
+stream no lattice governs — exactly the bug class that turns a rolling
+upgrade into a fleet-wide disconnect loop.
+
+Scoped to ``foundationdb_tpu/`` (tests construct raw streams
+deliberately to probe the mismatch paths); ``core/serialize.py`` itself
+is the negotiated path and is exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import FileCtx, Finding
+
+# Write primitives a version stamp could ride on.
+_WRITE_METHODS = {"u64", "u32", "i64", "raw"}
+# Argument names (last dotted component) that ARE the version.
+_VERSION_NAMES = {
+    "PROTOCOL_VERSION",
+    "MIN_COMPATIBLE_PROTOCOL_VERSION",
+}
+
+
+def _names_version(ctx: FileCtx, node: ast.AST) -> bool:
+    """True if the expression resolves to a protocol-version constant or
+    to the wire lattice's current/stamp value."""
+    if isinstance(node, ast.Call):
+        # WIRE_FORMAT.stamp() passed raw into a write primitive.
+        node = node.func
+        d = ctx.resolve(node) or ctx.dotted(node) or ""
+        return d.endswith("WIRE_FORMAT.stamp")
+    d = ctx.resolve(node) or ctx.dotted(node) or ""
+    last = d.rsplit(".", 1)[-1]
+    if last in _VERSION_NAMES:
+        return True
+    return d.endswith("WIRE_FORMAT.current")
+
+
+def check(ctx: FileCtx) -> list[Finding]:
+    if not ctx.path.startswith("foundationdb_tpu/"):
+        return []
+    if ctx.path == "foundationdb_tpu/core/serialize.py":
+        return []  # the negotiated path itself
+    findings: list[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _WRITE_METHODS):
+            continue
+        if any(_names_version(ctx, a) for a in node.args):
+            findings.append(Finding(
+                ctx.path, node.lineno, "wire-raw-protocol-version",
+                f".{node.func.attr}(PROTOCOL_VERSION)-style raw version "
+                "write bypasses the negotiated path — stamp via "
+                "BinaryWriter.write_protocol_version() (wire) or "
+                "write_durable_format() (durable) so the compatibility "
+                "lattice governs the stream",
+                end_line=getattr(node, "end_lineno", node.lineno),
+            ))
+    return findings

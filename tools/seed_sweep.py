@@ -123,6 +123,31 @@ def recruitment_spec(seed: int) -> dict:
     return spec
 
 
+def upgrade_spec(seed: int) -> dict:
+    """Per-seed variation of the upgrade restart base (specs/
+    upgrade_cycle.json: phase 2 boots at a BUMPED durable format version
+    and must read phase 1's stamped state bit-for-bit): randomized
+    storage engine, and — memory-engine seeds only — a coin flip ending
+    phase 1 via POWER LOSS over the simulated disk instead of a clean
+    shutdown. No datadir is named, so every run (including the
+    determinism rerun) cold-boots a fresh scratch disk. Deterministic
+    per seed; the printed spec IS the repro."""
+    import random
+
+    base_path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "specs", "upgrade_cycle.json")
+    with open(base_path) as f:
+        spec = json.load(f)
+    rng = random.Random(seed)
+    spec["seed"] = seed
+    spec["cluster"]["engine"] = rng.choice(["memory", "memory", "ssd"])
+    if spec["cluster"]["engine"] == "memory" and rng.random() < 0.4:
+        spec["phases"][0]["power_loss"] = True
+    if rng.random() < 0.5:
+        spec["cluster"]["n_storage"] = rng.randint(3, 6)
+    return spec
+
+
 def parse_seeds(spec: str) -> list[int]:
     if ":" in spec:
         lo, hi = spec.split(":", 1)
@@ -139,13 +164,17 @@ def main() -> int:
     ap.add_argument("--randomized", action="store_true",
                     help="derive each seed's spec via sim.config."
                          "generate_config instead of --spec")
-    ap.add_argument("--preset", choices=["regions", "recruitment"],
+    ap.add_argument("--preset",
+                    choices=["regions", "recruitment", "upgrade"],
                     help="named sweep preset: 'regions' = two-DC log "
                          "shipping chaos (DC kills + attrition) with "
                          "per-seed randomized replication modes; "
                          "'recruitment' = PERMANENT role-host machine "
                          "kills under fitness-ranked re-placement with "
-                         "randomized heartbeat/lease/stall-retry knobs")
+                         "randomized heartbeat/lease/stall-retry knobs; "
+                         "'upgrade' = restart specs whose phase 2 boots "
+                         "at a bumped durable format version (randomized "
+                         "engine, power-loss phase ends)")
     ap.add_argument("--seeds", default="20",
                     help='"lo:hi", "a,b,c", or a count N (default 20)')
     ap.add_argument("--check-determinism", action="store_true",
@@ -178,6 +207,8 @@ def main() -> int:
             spec = regions_spec(seed)
         elif args.preset == "recruitment":
             spec = recruitment_spec(seed)
+        elif args.preset == "upgrade":
+            spec = upgrade_spec(seed)
         else:
             spec = {**base, "seed": seed}
         offending: list = []
@@ -212,7 +243,13 @@ def main() -> int:
             # failed seed; the sweep must keep going and report it
             res = {"error": f"{type(e).__name__}: {e}"}
             ok, detail = False, ""
-        line = f"[seed {seed}] {'ok' if ok else 'FAIL'}{detail}"
+        # The drawn cluster SHAPE rides every line (and the repro block):
+        # an engine- or kind-specific failure is namable at a glance.
+        shape = spec.get("cluster", {})
+        shape_s = (f" kind={shape.get('kind', 'local')}"
+                   f" engine={shape.get('engine', 'memory')}"
+                   f" replication={shape.get('replication', '-')}")
+        line = f"[seed {seed}] {'ok' if ok else 'FAIL'}{detail}{shape_s}"
         if not ok:
             failures.append(seed)
             line += ("\n  error: " + str(res.get("error"))
