@@ -1336,6 +1336,46 @@ def _commit_plane_status(cluster_file: str) -> dict:
         return st
 
 
+def _commit_plane_metrics(cluster_file: str) -> dict:
+    """Scrape the txn host's MetricRegistry (WLTOKEN_METRICS) with the
+    ring-buffer series attached — the per-stage time-series evidence the
+    ROADMAP's 10K-commit and detector-knee items call for. Returns
+    {"counters": {name: total}, "gauges": {...}, "series": {name:
+    fine-resolution [(t, v), ...]}} trimmed to the commit-plane names."""
+    from foundationdb_tpu.cluster.multiprocess import (
+        WLTOKEN_METRICS,
+        MetricsRequest,
+        read_cluster_file,
+    )
+    from foundationdb_tpu.core.runtime import loop_context
+    from foundationdb_tpu.net.transport import real_loop_with_transport
+
+    info = read_cluster_file(cluster_file) or {}
+    loop, transport = real_loop_with_transport()
+    with loop_context(loop):
+        async def main():
+            req = MetricsRequest(pattern="", series=True)
+            transport.remote_stream(info["txn"], WLTOKEN_METRICS).send(req)
+            return await req.reply.future
+
+        reply = loop.run(main(), timeout_sim_seconds=30)
+        transport.close()
+    out: dict = {"counters": {}, "gauges": {}, "series": {}}
+    series_names = {"proxy.txns_committed", "proxy.grvs_served",
+                    "proxy.commit_inflight_depth", "process.resident_bytes"}
+    for m in reply.get("metrics", []):
+        v = m.get("value")
+        if m.get("kind") == "counter" and isinstance(v, (int, float)):
+            out["counters"][m["name"]] = v
+        elif m.get("kind") in ("gauge", "smoother") \
+                and isinstance(v, (int, float)):
+            out["gauges"][m["name"]] = v
+        if m["name"] in series_names:
+            fine = (m.get("series") or {}).get("fine") or []
+            out["series"][m["name"]] = fine[-120:]
+    return out
+
+
 def measure_commit_plane(seed: int) -> dict:
     """ISSUE 8 acceptance leg: a real `server.py -r fdbd` 3-process
     cluster (log/storage/txn over localhost TCP) under a ramp of
@@ -1428,6 +1468,12 @@ def measure_commit_plane(seed: int) -> dict:
                     .get("commit_pipeline", {})
                     .get("latency_bands")
                 )
+                # Metrics-plane scrape (registry totals + the ring-buffer
+                # time series accumulated during this ramp stage).
+                try:
+                    leg["metrics"] = _commit_plane_metrics(cf)
+                except Exception as e:  # noqa: BLE001 - evidence, not gate
+                    leg["metrics"] = {"error": f"{type(e).__name__}: {e}"}
                 legs.append(leg)
                 log(f"[commit-plane] {leg['clients']} clients: "
                     f"{leg['commits_per_sec']:.0f} commits/s  "

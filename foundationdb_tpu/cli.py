@@ -30,6 +30,17 @@ Commands (the fdbcli core surface):
                                   batch's attach edge)
     events [--type T] [--severity N] [--last N]
                                   tail the fleet's recent trace events
+    metrics [pattern]             one-shot metrics query: every process's
+                                  registry entries matching the fnmatch
+                                  pattern (e.g. `metrics proxy.*`)
+    top [--iterations N] [--interval S]
+                                  live per-role rates (commits/s, GRV/s,
+                                  resolver percentiles, tlog qbytes,
+                                  pipeline depth) from consecutive
+                                  scrapes of every process, plus the hot
+                                  commit band's exemplar debug ID (jump
+                                  to `trace <id>`); N=0 refreshes until
+                                  Ctrl-C
     configure <k=v> ...           set replicated configuration (\xff/conf)
     configuration                 show replicated configuration
     exclude [tag ...]             exclude storage servers (no args: list);
@@ -249,6 +260,150 @@ class Cli:
                 out.append((proc, e))
         return out
 
+    # -- metrics plane (metrics / top verbs) --
+    def fetch_metrics(self, pattern: str = "",
+                      series: bool = False) -> dict[str, list]:
+        """{process: [metric entries]} scraped from every process of the
+        deployment (attached: MetricsRequest over WLTOKEN_METRICS) or
+        from the embedded cluster's per-loop registry. Unreachable
+        processes are skipped, like the trace fan-out."""
+        if self._ctrl is None:
+            from .core.metrics import global_registry
+
+            snap = global_registry().snapshot(
+                volatile=True, pattern=pattern or "", series=series
+            )
+            return {"local": json.loads(json.dumps(snap, default=str))}
+        from .cluster import multiprocess as mp
+        from .core.actors import timeout
+
+        out: dict[str, list] = {}
+        for role, addr in self._trace_addresses().items():
+            req = mp.MetricsRequest(pattern=pattern or "", series=series)
+            stream = self._transport.remote_stream(addr, mp.WLTOKEN_METRICS)
+
+            async def rpc(req=req, stream=stream):
+                stream.send(req)
+                return await timeout(req.reply.future, 10, None)
+
+            reply = self._run(rpc(), timeout=15)
+            if reply is None:
+                continue
+            out[reply.get("process") or role] = reply.get("metrics", [])
+        return out
+
+    @staticmethod
+    def _metric_map(entries: list) -> dict:
+        """(name, labels) -> entry, for rate math between two scrapes."""
+        return {
+            (e["name"], tuple(sorted((e.get("labels") or {}).items()))): e
+            for e in entries
+        }
+
+    @staticmethod
+    def _bands_percentile(value: dict, q: float):
+        """Approximate percentile from a cumulative LatencyBands status
+        value: the smallest edge covering fraction q (None if empty)."""
+        total = value.get("total") or 0
+        if not total:
+            return None
+        need = q * total
+        for edge, acc in value.get("bands_ms", {}).items():
+            if edge != "inf" and acc >= need:
+                return float(edge)
+        return float("inf")
+
+    def _render_top_frame(self, prev: dict, cur: dict, dt: float) -> str:
+        """One `top` frame: per-process rates (from consecutive counter
+        scrapes), pipeline gauges, resolver percentiles, and the hot
+        commit band's exemplar debug ID (the jump-off to `trace <id>`)."""
+        lines = [f"fdbtpu top — {len(cur)} process(es), "
+                 f"window {dt:.1f}s  (rates are per second)"]
+        hot_exemplar = None
+        hot_edge = None
+        for proc in sorted(cur):
+            cm = self._metric_map(cur[proc])
+            pm = self._metric_map(prev.get(proc, []))
+
+            def rate(name, cm=cm, pm=pm):
+                tot = sum(e["value"] for (n, _), e in cm.items()
+                          if n == name and isinstance(e["value"], (int, float)))
+                was = sum(e["value"] for (n, _), e in pm.items()
+                          if n == name and isinstance(e["value"], (int, float)))
+                return (tot - was) / dt if dt > 0 else 0.0
+
+            def gauge(name, cm=cm):
+                vals = [e["value"] for (n, _), e in cm.items() if n == name
+                        and isinstance(e["value"], (int, float))]
+                return sum(vals) if vals else None
+
+            cells = []
+            if any(n == "proxy.txns_committed" for n, _ in cm):
+                cells.append(f"commits/s {rate('proxy.txns_committed'):8.1f}")
+                cells.append(f"grv/s {rate('proxy.grvs_served'):8.1f}")
+                cells.append(
+                    f"conflicts/s {rate('proxy.txns_conflicted'):6.1f}")
+                d = gauge("proxy.commit_inflight_depth")
+                if d is not None:
+                    cells.append(f"pipeline depth {int(d)}")
+            for (n, _), e in sorted(cm.items()):
+                if n == "proxy.commit_ms" and isinstance(e["value"], dict):
+                    ex = e["value"].get("exemplars") or {}
+                    for edge in sorted(
+                        ex, key=lambda k: float("inf") if k == "inf"
+                        else float(k)
+                    ):
+                        hot_exemplar, hot_edge = ex[edge], edge
+            if any(n == "resolver.batch_ms" for n, _ in cm):
+                vals = [e["value"] for (n, _), e in cm.items()
+                        if n == "resolver.batch_ms"]
+                p50 = self._bands_percentile(vals[0], 0.5)
+                p99 = self._bands_percentile(vals[0], 0.99)
+                cells.append(f"resolve p50<= {p50}ms p99<= {p99}ms")
+                cells.append(
+                    f"resolved/s {rate('resolver.txns_count'):8.1f}")
+            qb = gauge("tlog.queue_bytes")
+            if qb is not None:
+                cells.append(f"tlog qbytes {int(qb)}")
+            dv = gauge("storage.data_version")
+            if dv is not None:
+                cells.append(f"storage v {int(dv)}")
+            rss = gauge("process.resident_bytes")
+            if rss is not None:
+                cells.append(f"rss {int(rss) >> 20}MB")
+            lines.append(f"  [{proc:<28}] " + "  ".join(cells))
+        if hot_exemplar:
+            lines.append(
+                f"  hot commit band (<= {hot_edge} ms) exemplar: "
+                f"{hot_exemplar}  — `trace {hot_exemplar}` for its "
+                "cross-process timeline"
+            )
+        return "\n".join(lines)
+
+    def top(self, iterations: int = 1, interval: float = 1.0,
+            echo=None) -> str:
+        """Live per-role view: scrape, wait `interval`, scrape again,
+        render rates; repeat `iterations` times (0 = until Ctrl-C).
+        Returns the last frame (intermediate frames go to `echo`)."""
+        from .core.runtime import current_loop
+
+        async def pause():
+            await current_loop().delay(interval)
+
+        prev = self.fetch_metrics()
+        frame = ""
+        i = 0
+        while True:
+            self._run(pause(), timeout=interval + 30)
+            cur = self.fetch_metrics()
+            frame = self._render_top_frame(prev, cur, interval)
+            prev = cur
+            i += 1
+            if iterations and i >= iterations:
+                return frame
+            if echo is not None:
+                echo("\x1b[2J\x1b[H" + frame)
+
     def trace_timeline(self, debug_id: str) -> list[tuple[str, dict]]:
         """The stitched flight-recorder timeline of one debug ID: its own
         events, plus (following TransactionAttach edges both ways) the
@@ -424,6 +579,37 @@ class Cli:
             if len(args) != 1:
                 return "usage: trace <debug-id>"
             return self._render_timeline(args[0])
+        if cmd == "metrics":
+            pattern = args[0] if args else ""
+            per_proc = self.fetch_metrics(pattern=pattern)
+            lines = []
+            for proc in sorted(per_proc):
+                for e in per_proc[proc]:
+                    lbl = "".join(
+                        f"{{{k}={v}}}" for k, v in
+                        sorted((e.get("labels") or {}).items())
+                    )
+                    v = e["value"]
+                    if isinstance(v, dict):
+                        v = json.dumps(v, sort_keys=True)
+                    lines.append(
+                        f"[{proc:<28}] {e['name']}{lbl} = {v}"
+                    )
+            return "\n".join(lines) if lines else (
+                f"no metrics match {pattern!r}"
+            )
+        if cmd == "top":
+            iterations, interval = 1, 1.0
+            it = iter(args)
+            for a in it:
+                if a == "--iterations":
+                    iterations = int(next(it))
+                elif a == "--interval":
+                    interval = float(next(it))
+                else:
+                    return "usage: top [--iterations N] [--interval S]"
+            return self.top(iterations=iterations, interval=interval,
+                            echo=print)
         if cmd == "events":
             kw: dict = {}
             last = 20

@@ -47,6 +47,27 @@ class ClusterConnection:
         # UNANSWERED, so the served version is always read by the server
         # after every joiner asked (external consistency holds).
         self._grv_shared: dict = {}  # priority -> Promise
+        # Client-side GRV/commit counters on the metrics plane (ref: the
+        # reference's TransactionMetrics CounterCollection in NativeAPI):
+        # what a client process's scrape shows of ITS half of the commit
+        # path. One connection per process is the deployed shape; a later
+        # connection on the same loop supersedes (replace=True).
+        from ..core.metrics import global_registry
+        from ..core.stats import Counter
+
+        self.c_grvs = Counter("GRVsIssued")
+        self.c_grvs_coalesced = Counter("GRVsCoalesced")
+        self.c_commits_started = Counter("CommitsStarted")
+        self.c_commits_unknown = Counter("CommitsUnknownResult")
+        reg = global_registry()
+        reg.register_counter("client.grvs_issued", self.c_grvs,
+                             replace=True)
+        reg.register_counter("client.grvs_coalesced",
+                             self.c_grvs_coalesced, replace=True)
+        reg.register_counter("client.commits_started",
+                             self.c_commits_started, replace=True)
+        reg.register_counter("client.commits_unknown_result",
+                             self.c_commits_unknown, replace=True)
 
     async def _retrying(self, make_req, endpoint, request_timeout: float):
         """Idempotent request: re-send (a fresh request) on timeout OR
@@ -90,6 +111,8 @@ class ClusterConnection:
         if not CLIENT_KNOBS.GRV_COALESCE or debug_id is not None:
             return await self._grv_fetch(priority, debug_id)
         shared = self._grv_shared.get(priority)
+        if shared is not None and not shared.future.is_set():
+            self.c_grvs_coalesced.add(1)
         if shared is None or shared.future.is_set():
             from ..core.runtime import Promise, spawn
 
@@ -110,6 +133,7 @@ class ClusterConnection:
         return await shared.future
 
     async def _grv_fetch(self, priority: int, debug_id=None) -> int:
+        self.c_grvs.add(1)
         return await self._retrying(
             lambda: GetReadVersionRequest(priority=priority,
                                           debug_id=debug_id),
@@ -138,6 +162,7 @@ class ClusterConnection:
     async def commit(self, req: CommitTransactionRequest):
         from ..core.errors import BrokenPromise, ConnectionFailed
 
+        self.c_commits_started.add(1)
         self.commit_endpoint.send(req)
         try:
             result = await timeout(
@@ -146,10 +171,12 @@ class ClusterConnection:
         except (ConnectionFailed, BrokenPromise) as e:
             # The connection died with the commit in flight: ambiguous
             # (the proxy may have pushed the batch before the link broke).
+            self.c_commits_unknown.add(1)
             raise CommitUnknownResult(str(e))
         if result is _LOST:
             # The batch may or may not have committed — the defining OCC
             # client ambiguity (ref: commit_unknown_result).
+            self.c_commits_unknown.add(1)
             raise CommitUnknownResult()
         return result
 
@@ -201,6 +228,7 @@ class ShardedConnection(ClusterConnection):
         from ..core.errors import BrokenPromise, ConnectionFailed
         from ..core.runtime import spawn
 
+        self.c_commits_started.add(1)
         if self._commit_coalesce is None:
             self._commit_coalesce = []
         self._commit_coalesce.append(req)
@@ -218,8 +246,10 @@ class ShardedConnection(ClusterConnection):
                 req.reply.future, CLIENT_KNOBS.COMMIT_TIMEOUT, _LOST
             )
         except (ConnectionFailed, BrokenPromise) as e:
+            self.c_commits_unknown.add(1)
             raise CommitUnknownResult(str(e))
         if result is _LOST:
+            self.c_commits_unknown.add(1)
             raise CommitUnknownResult()
         return result
 

@@ -38,6 +38,11 @@ class LocalCluster:
     def start(self) -> "LocalCluster":
         assert not self._started
         self._started = True
+        from ..core.metrics import global_registry
+
+        reg = global_registry()
+        self.tlog.register_metrics(reg)
+        self.storage.register_metrics(reg)
         self.storage.start()
         self.ratekeeper.start()
         self.proxy.start()

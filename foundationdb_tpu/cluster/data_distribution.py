@@ -263,9 +263,24 @@ class DataDistributor:
         if rk is not None:
             rk.set_excluded(self.failed)
 
+    def register_metrics(self, registry=None) -> None:
+        """DD progress gauges on the per-process MetricRegistry."""
+        from ..core.metrics import global_registry
+
+        reg = registry if registry is not None else global_registry()
+        reg.register_gauge("data_distribution.moves_count",
+                           lambda: self.moves_done, replace=True)
+        reg.register_gauge("data_distribution.splits_count",
+                           lambda: self.splits_done, replace=True)
+        reg.register_gauge("data_distribution.merges_count",
+                           lambda: self.merges_done, replace=True)
+        reg.register_gauge("data_distribution.failed_servers_count",
+                           lambda: len(self.failed), replace=True)
+
     def start(self) -> None:
         self._tasks.add(spawn(self._tracker_loop(), TaskPriority.DEFAULT,
                               name="ddTracker"))
+        self.register_metrics()
 
     def stop(self) -> None:
         self._tasks.cancel_all()

@@ -129,6 +129,21 @@ class DurableTaggedTLog(TaggedTLog):
     def spilled_entries(self) -> int:
         return len(self._spill_bytes_by_v)
 
+    def register_metrics(self, registry=None, labels=()) -> None:
+        """The memory-tier gauges plus the durable tier's spill split —
+        how much of the un-popped queue lives on disk vs in memory."""
+        super().register_metrics(registry, labels)
+        from ..core.metrics import global_registry
+
+        reg = registry if registry is not None else global_registry()
+        lbl = tuple(labels)
+        reg.register_gauge("tlog.spilled_bytes",
+                           lambda: self.spilled_bytes,
+                           labels=lbl, replace=True)
+        reg.register_gauge("tlog.memory_bytes",
+                           lambda: self._mem_bytes,
+                           labels=lbl, replace=True)
+
     # -- record IO --
     def _push_blob(self, kind: int, payload: bytes) -> int:
         ch = DiskQueue.PAYLOAD_MAX - 2

@@ -661,13 +661,23 @@ class TagPartitionedLogSystem:
         """Un-popped payload held across the serving logs (ratekeeper
         input, ref: TLogQueueInfo). SPILLED backlog counts too — the
         queue does not shrink just because it moved to disk."""
-        total = 0
-        for log in self.logs:
-            for _, tms in log._entries:
-                for tm in tms:
-                    total += len(tm.mutation.param1) + len(tm.mutation.param2)
-            total += getattr(log, "spilled_bytes", 0)
-        return total
+        return sum(log.queue_bytes() for log in self.logs)
+
+    def register_metrics(self, registry=None) -> None:
+        """System-level gauges plus every serving log's per-log gauges
+        (labeled by global log id / log set) on the MetricRegistry."""
+        from ..core.metrics import global_registry
+
+        reg = registry if registry is not None else global_registry()
+        reg.register_gauge("log_system.queue_bytes", self.queue_bytes,
+                           replace=True)
+        reg.register_gauge("log_system.durable_version",
+                           self.durable_version, replace=True)
+        for set_idx, log_set in enumerate(self.log_sets):
+            for i, log in enumerate(log_set):
+                log.register_metrics(
+                    reg, labels=(("log", str(i)), ("set", str(set_idx))),
+                )
 
 
 class LogRouter:

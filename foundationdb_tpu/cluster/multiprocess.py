@@ -59,6 +59,7 @@ WLTOKEN_COMMIT_BATCH = 14    # columnar CommitBatchRequest (commit_wire.py)
 WLTOKEN_TXN_STATUS = 15      # TxnStatusRequest: commit-plane status pull
 WLTOKEN_CONTROLLER = 16      # worker registration + status/recruitment pulls
 WLTOKEN_TRACE = 17           # TraceEventsRequest: flight-recorder queries
+WLTOKEN_METRICS = 18         # MetricsRequest: per-process registry scrapes
 WLTOKEN_LOG_BASE = 100       # +2*i commit, +2*i+1 control
 WLTOKEN_STORAGE_BASE = 300   # +2*tag read, +2*tag+1 control
 WLTOKEN_RESOLVER_BASE = 500  # host control; +1+idx per-resolver resolve
@@ -209,6 +210,22 @@ class TraceEventsRequest:
 
 
 @dataclass
+class MetricsRequest:
+    """Metrics scrape served by EVERY role host (WLTOKEN_METRICS): the
+    process's MetricRegistry snapshot — name/labels/kind/value per
+    registered instrument, optionally with the ring-buffer recent
+    history (TDMetric-style fine+coarse series). `pattern` is an fnmatch
+    glob over dotted names (empty = everything). `cli.py top` fans one
+    per process and renders live rates from consecutive scrapes;
+    `cli.py metrics <pattern>` is the one-shot query; `bench.py
+    --commit-plane` records the series per ramp stage."""
+
+    pattern: str = ""
+    series: bool = False
+    reply: Promise = field(default_factory=Promise)
+
+
+@dataclass
 class TxnStatusRequest:
     """Operator/bench pull of the txn host's commit-plane status: the
     proxy's `commit_pipeline` block (grv/form/resolve/tlog stage p50+p99,
@@ -223,7 +240,7 @@ for _cls in (
     TLogPeekRequest, TLogPopRequest, TLogLockRequest, TLogTruncateRequest,
     TLogSkipToRequest, TLogStatusRequest, TLogConfirmEpochRequest,
     TLogHostDurableRequest, StorageRollbackRequest, StorageStatusRequest,
-    TxnStatusRequest, TraceEventsRequest, TaggedMutation,
+    TxnStatusRequest, TraceEventsRequest, MetricsRequest, TaggedMutation,
     InitResolversRequest, ResolverSkipWindowRequest, ResolverStatusRequest,
     ResolveBatchReply,
 ):
@@ -268,6 +285,33 @@ def start_trace_service(transport, tasks: ActorCollection) -> None:
 
     tasks.add(serve_requests(stream, serve, TaskPriority.DEFAULT,
                              "traceQuery"))
+
+
+def start_metrics_service(transport, tasks: ActorCollection) -> None:
+    """Serve MetricsRequest from this process's MetricRegistry — the
+    per-process leg of the scrape plane (every role host calls this; the
+    HTTP text-exposition endpoint is the same registry re-rendered)."""
+    import json as _json
+
+    stream: PromiseStream = PromiseStream()
+    transport.register_endpoint(stream, WLTOKEN_METRICS)
+
+    async def serve(req: MetricsRequest):
+        from ..core.metrics import global_registry
+        from ..core.trace import global_sink
+
+        snap = global_registry().snapshot(
+            volatile=True, pattern=req.pattern or "",
+            series=bool(req.series),
+        )
+        # Pin values to codec-safe primitives exactly like the trace
+        # query path (gauges may return arbitrary objects).
+        snap = _json.loads(_json.dumps(snap, default=str))
+        return {"process": global_sink().process_name, "metrics": snap}
+
+    tasks.add(serve_requests(stream, serve, TaskPriority.DEFAULT,
+                             "metricsQuery"))
+
 
 # Importing the module registers CommitBatchRequest with the wire codec —
 # the txn host must be able to DECODE a client's columnar commit batch
@@ -469,6 +513,7 @@ class LogHost:
         }
         self._tasks = ActorCollection()
         for i, log in self.logs.items():
+            log.register_metrics(labels=(("log", str(i)),))
             commit_stream: PromiseStream = PromiseStream()
             ctrl_stream: PromiseStream = PromiseStream()
             transport.register_endpoint(commit_stream,
@@ -533,14 +578,11 @@ class LogHost:
             log.skip_to(req.version)
             return None
         if isinstance(req, TLogStatusRequest):
-            # SPILLED backlog counts too (mirrors log_system.queue_bytes):
-            # the un-popped queue does not shrink just because it moved to
-            # disk, and ratekeeper backpressure must keep seeing it.
-            qbytes = sum(
-                len(tm.mutation.param1) + len(tm.mutation.param2)
-                for _, tms in log._entries for tm in tms
-            ) + getattr(log, "spilled_bytes", 0)
-            return (log.version.get(), log.durable.get(), qbytes)
+            # queue_bytes counts SPILLED backlog too (the un-popped queue
+            # does not shrink just because it moved to disk, and
+            # ratekeeper backpressure must keep seeing it).
+            return (log.version.get(), log.durable.get(),
+                    log.queue_bytes())
         if isinstance(req, TLogConfirmEpochRequest):
             return log.locked_epoch
         if isinstance(req, TLogHostDurableRequest):
@@ -795,6 +837,7 @@ class StorageHost:
             eng = _make_engine(spec.get("engine", "memory"),
                                f"{datadir}/storage{tag}")
             s = StorageServer(view, 0, tag=tag, engine=eng)
+            s.register_metrics(labels=(("tag", str(tag)),))
             s.owned = _all_false_map()
             s.assigned = _all_false_map()
             for lo, hi, team in layout:
@@ -873,8 +916,9 @@ class ResolverHost:
             self.generation = req.generation
             self.roles = [
                 ResolverRole(make_conflict_set(req.start_version),
-                             init_version=req.start_version)
-                for _ in range(self.n_resolvers)
+                             init_version=req.start_version,
+                             metrics_labels=(("resolver", str(i)),))
+                for i in range(self.n_resolvers)
             ]
             TraceEvent("ResolverHostRecruited").detail(
                 "Generation", req.generation
@@ -1238,6 +1282,15 @@ class TxnHost:
             transport, list(self.log_addrs), self.n_logs,
             log_replication=kw["log_replication"], topology=kw["topology"],
         )
+        # The txn host's view of the log quorum on the metrics plane
+        # (poller-refreshed caches — the same numbers ratekeeper reads).
+        from ..core.metrics import global_registry as _greg
+
+        _reg = _greg()
+        _reg.register_gauge("log_system.queue_bytes",
+                            self.log_system.queue_bytes, replace=True)
+        _reg.register_gauge("log_system.durable_version",
+                            self.log_system.durable_version, replace=True)
         self._bind_storage_streams()
         self.shard_map = ShardMap(default_team=())
         for lo, hi, team in derive_layout(
@@ -2086,7 +2139,8 @@ def start_worker_registration(transport, cluster_file: str, role_class: str,
 
 def run_role_host(role_class: str, cluster_file: str, datadir: str,
                   port: int = 0, ready=None, stop_event=None,
-                  machine_id: str = "", trace_dir: str = "") -> None:
+                  machine_id: str = "", trace_dir: str = "",
+                  metrics_port: int = 0) -> None:
     """Run one role host on a real-clock loop until stop_event. The host
     merges its listen address into the cluster file; hosts needing peers
     wait for the peers' addresses to appear (discovery via the shared
@@ -2181,11 +2235,41 @@ def run_role_host(role_class: str, cluster_file: str, datadir: str,
         async def main():
             host = None
             reg_task = None
+            http_metrics = None
             # Flight-recorder query endpoint: EVERY role host serves its
             # in-memory trace window over WLTOKEN_TRACE so `cli.py trace`
             # / `events` can stitch cross-process timelines.
             trace_tasks = ActorCollection()
             start_trace_service(transport, trace_tasks)
+            # Metrics plane: every role host serves its MetricRegistry
+            # over WLTOKEN_METRICS, samples the ring-buffer series, and
+            # surfaces process health (RSS/FDs/CPU/loop lag) as volatile
+            # gauges; an optional HTTP port serves the Prometheus text
+            # exposition (--metrics-port / the spec's metrics_ports map).
+            from ..core.metrics import global_registry
+            from ..core.system_monitor import SystemMonitor
+
+            registry = global_registry()
+            start_metrics_service(transport, trace_tasks)
+            registry.start_sampler()
+            sysmon = SystemMonitor()
+            sysmon.register_metrics(registry)
+            sysmon.start()
+            mport = (spec.get("metrics_ports", {}) or {}).get(
+                role_class, metrics_port
+            )
+            if mport:
+                from ..net.http import TextHTTPServer
+
+                http_metrics = TextHTTPServer(
+                    int(mport),
+                    lambda: registry.prometheus_text(),
+                    content_type="text/plain; version=0.0.4",
+                )
+                http_metrics.start()
+                TraceEvent("MetricsHTTPServing").detail(
+                    "Port", http_metrics.port
+                ).log()
             if role_class in log_keys:
                 idx = log_keys.index(role_class)
                 host = LogHost(transport, f"{datadir}/log",
@@ -2274,6 +2358,10 @@ def run_role_host(role_class: str, cluster_file: str, datadir: str,
             finally:
                 if reg_task is not None:
                     reg_task.cancel()
+                sysmon.stop()
+                registry.stop_sampler()
+                if http_metrics is not None:
+                    http_metrics.stop()
                 trace_tasks.cancel_all()
                 host.stop()
 

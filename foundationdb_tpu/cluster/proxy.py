@@ -153,7 +153,9 @@ class CommitProxy:
                  ratekeeper=None, generation: int = 0,
                  resolver_endpoint=None, tlog_endpoint=None,
                  log_system=None, shard_map=None,
-                 resolvers=None, resolver_config=None):
+                 resolvers=None, resolver_config=None,
+                 metrics_labels=()):
+        self.metrics_labels = tuple(metrics_labels)
         self.master = master
         self.resolver = resolver
         # Multi-resolver mode (ref: ResolutionRequestBuilder): when
@@ -234,6 +236,40 @@ class CommitProxy:
         self._c_grv = self.stats.counter("GRVsServed")
         self._c_grv_throttled = self.stats.counter("GRVsThrottled")
         self._c_grv_cached = self.stats.counter("GRVsCachedFastPath")
+        self.register_metrics()
+
+    def register_metrics(self, registry=None) -> None:
+        """Register this proxy's instruments on the per-process
+        MetricRegistry under stable dotted names (replace=True: a
+        recovered generation's proxy supersedes its predecessor's)."""
+        from ..core.metrics import global_registry
+
+        reg = registry if registry is not None else global_registry()
+        lbl = self.metrics_labels
+        for name, c in (
+            ("proxy.txns_committed", self._c_committed),
+            ("proxy.txns_conflicted", self._c_conflicted),
+            ("proxy.txns_too_old", self._c_too_old),
+            ("proxy.grvs_served", self._c_grv),
+            ("proxy.grvs_throttled", self._c_grv_throttled),
+            ("proxy.grvs_cached", self._c_grv_cached),
+        ):
+            reg.register_counter(name, c, labels=lbl, replace=True)
+        reg.register_bands("proxy.grv_ms", self.latency_bands["grv"],
+                           labels=lbl, replace=True)
+        reg.register_bands("proxy.commit_ms", self.latency_bands["commit"],
+                           labels=lbl, replace=True)
+        for stage, s in self.commit_stage_samples.items():
+            reg.register_sample(
+                "proxy.commit_stage_ms", s,
+                labels=lbl + (("stage", stage[:-3]),), replace=True,
+            )
+        reg.register_gauge("proxy.commit_inflight_depth",
+                           lambda: len(self._commit_inflight),
+                           labels=lbl, replace=True)
+        reg.register_gauge("proxy.batch_interval_seconds",
+                           lambda: round(self._batch_interval.value, 6),
+                           labels=lbl, replace=True)
 
     @property
     def txns_committed(self) -> int:
@@ -454,7 +490,12 @@ class CommitProxy:
         grv_s = loop.now() - t0
         self.commit_stage_samples["grv_ms"].add_sample(grv_s * 1e3)
         if answered:
-            self.latency_bands["grv"].add(grv_s, n=answered)
+            # Exemplar: a sampled request's debug ID rides the band it
+            # landed in, so `cli.py top` can jump from a hot GRV band
+            # straight to `cli.py trace <id>`.
+            dbg = next((r.debug_id for r in reqs
+                        if getattr(r, "debug_id", None)), None)
+            self.latency_bands["grv"].add(grv_s, n=answered, exemplar=dbg)
 
     # -- commit pipeline --
     async def _commit_batch(self, reqs: list[CommitTransactionRequest]):
@@ -485,8 +526,12 @@ class CommitProxy:
             self._batch_interval.record_latency(batch_s)
             # Band every answered commit at the batch's pipeline latency
             # (window take -> replies released) — the per-request shape
-            # operators' latency_bands dashboards expect.
-            self.latency_bands["commit"].add(batch_s, n=len(reqs))
+            # operators' latency_bands dashboards expect. A sampled txn's
+            # debug ID rides as the band's exemplar (band -> trace <id>).
+            dbg = next((r.debug_id for r in reqs
+                        if getattr(r, "debug_id", None)), None)
+            self.latency_bands["commit"].add(batch_s, n=len(reqs),
+                                             exemplar=dbg)
         except GeneratorExit:
             # Interpreter GC of a parked coroutine (a dead generation's
             # batch collected during a LATER simulation run): not a

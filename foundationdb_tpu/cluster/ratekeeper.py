@@ -48,8 +48,33 @@ class Ratekeeper:
         self.target_lag_versions = SERVER_KNOBS.STORAGE_DURABILITY_LAG_VERSIONS // 10
         self.max_lag_versions = SERVER_KNOBS.STORAGE_DURABILITY_LAG_VERSIONS
 
+    def register_metrics(self, registry=None) -> None:
+        """The control loop's observable state on the MetricRegistry: the
+        computed admission limit and the smoothed lag driving it — the
+        queue telemetry the reference's Ratekeeper scrapes, re-exported."""
+        from ..core.metrics import global_registry
+
+        reg = registry if registry is not None else global_registry()
+        reg.register_gauge(
+            "ratekeeper.limit_tps",
+            lambda: -1.0 if self.tps_limit == float("inf")
+            else round(self.tps_limit, 3),
+            replace=True,
+            help="admission budget in tps (-1 = unlimited)",
+        )
+        reg.register_smoother("ratekeeper.smoothed_lag_versions", self._lag,
+                              replace=True)
+        reg.register_gauge(
+            "ratekeeper.durability_lag_versions",
+            lambda: self._durable() - min(
+                s.version.get() for s in self._live_storages()
+            ),
+            replace=True,
+        )
+
     def start(self) -> None:
         self._task = spawn(self._update_loop(), name="ratekeeper")
+        self.register_metrics()
 
     def stop(self) -> None:
         if self._task is not None:

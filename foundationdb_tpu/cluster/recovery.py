@@ -520,8 +520,9 @@ class RecoverableShardedCluster:
         if inner.resolver_config is not None:
             inner.resolvers = [
                 ResolverRole(self.conflict_set_factory(start_version),
-                             init_version=start_version)
-                for _ in range(inner.n_resolvers)
+                             init_version=start_version,
+                             metrics_labels=(("resolver", str(i)),))
+                for i in range(inner.n_resolvers)
             ]
             inner.resolver_config.transitions.clear()
         else:
@@ -542,8 +543,11 @@ class RecoverableShardedCluster:
                 resolvers=(inner.resolvers
                            if inner.resolver_config is not None else None),
                 resolver_config=inner.resolver_config,
+                metrics_labels=(
+                    (("proxy", str(i)),) if inner.n_proxies > 1 else ()
+                ),
             )
-            for _ in range(inner.n_proxies)
+            for i in range(inner.n_proxies)
         ]
         inner.proxy = inner.proxies[0]
         for p in inner.proxies:

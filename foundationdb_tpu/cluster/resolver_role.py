@@ -79,9 +79,11 @@ class ResolverRole:
         if self._consumed.get() == prev_version:
             self._consumed.set(version)
 
-    def __init__(self, conflict_set, init_version: int = 0):
+    def __init__(self, conflict_set, init_version: int = 0,
+                 metrics_labels=()):
         from ..core.actors import PromiseStream
 
+        self.metrics_labels = tuple(metrics_labels)
         self.cs = conflict_set
         self.resolve_stream = PromiseStream()
         self.version = NotifiedVersion(init_version)
@@ -111,6 +113,37 @@ class ResolverRole:
         # (only resolver 0 is fed — the system keyspace's single home).
         self._pending_state: dict[int, list] = {}   # version -> [(idx, m)]
         self.state_store: dict[int, tuple] = {}     # version -> (Mutation,)
+        self.register_metrics()
+
+    def register_metrics(self, registry=None) -> None:
+        """Register this resolver's instruments on the per-process
+        MetricRegistry (replace=True: per-generation roles supersede;
+        multi-resolver fleets disambiguate via metrics_labels)."""
+        from ..core.metrics import global_registry
+
+        reg = registry if registry is not None else global_registry()
+        lbl = self.metrics_labels
+        reg.register_gauge("resolver.batches_count",
+                           lambda: self.conflict_batches,
+                           labels=lbl, replace=True)
+        reg.register_gauge("resolver.txns_count",
+                           lambda: self.total_transactions,
+                           labels=lbl, replace=True)
+        reg.register_gauge("resolver.conflicts_count",
+                           lambda: self.conflict_transactions,
+                           labels=lbl, replace=True)
+        reg.register_gauge("resolver.keys_resolved_count",
+                           lambda: self.keys_resolved,
+                           labels=lbl, replace=True)
+        reg.register_gauge("resolver.inflight_depth",
+                           lambda: len(self._inflight_q),
+                           labels=lbl, replace=True)
+        reg.register_bands("resolver.batch_ms", self.latency_bands,
+                           labels=lbl, replace=True)
+        for stage, s in self.stage_samples.items():
+            reg.register_sample("resolver.stage_ms", s,
+                                labels=lbl + (("stage", stage[:-3]),),
+                                replace=True)
 
     _SAMPLE_CAP = 64
 
@@ -263,7 +296,7 @@ class ResolverRole:
         self._retain_state(req)
         n_conflict = sum(1 for s in result.statuses if s != 0)
         self.conflict_transactions += n_conflict
-        self.latency_bands.add(current_loop().now() - t0)
+        self.latency_bands.add(current_loop().now() - t0, exemplar=dbg)
         trace_txn_event("Resolver.Verdict", dbg, Version=req.version,
                         Conflicts=n_conflict)
         if wb is not None:

@@ -157,8 +157,9 @@ class ShardedKVCluster:
             ])
             self.resolver_config = ResolverConfig(bounds)
             self.resolvers = [
-                ResolverRole(make_conflict_set(0), 0)
-                for _ in range(n_resolvers)
+                ResolverRole(make_conflict_set(0), 0,
+                             metrics_labels=(("resolver", str(i)),))
+                for i in range(n_resolvers)
             ]
         else:
             self.resolvers = [ResolverRole(
@@ -175,8 +176,11 @@ class ShardedKVCluster:
                 log_system=self.log_system, shard_map=self.shard_map,
                 resolvers=self.resolvers if n_resolvers > 1 else None,
                 resolver_config=self.resolver_config,
+                metrics_labels=(
+                    (("proxy", str(i)),) if n_proxies > 1 else ()
+                ),
             )
-            for _ in range(n_proxies)
+            for i in range(n_proxies)
         ]
         self.proxy = self.proxies[0]
         # Replicated cluster configuration, maintained from committed \xff
@@ -215,7 +219,16 @@ class ShardedKVCluster:
                 "sequence there)"
             )
         self._started = True
+        # The metrics plane: every role's instruments land on the
+        # per-process registry under stable dotted names (proxy/resolver
+        # registered themselves at construction; fleets with per-instance
+        # identity register here where the index/tag is known).
+        from ..core.metrics import global_registry
+
+        reg = global_registry()
+        self.log_system.register_metrics(reg)
         for s in self.storages:
+            s.register_metrics(reg, labels=(("tag", str(s.tag)),))
             s.start()
         self.ratekeeper.start()
         for p in self.proxies:

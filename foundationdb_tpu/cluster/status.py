@@ -19,6 +19,18 @@ def cluster_status(cluster) -> dict[str, Any]:
     return _local_status(cluster)
 
 
+def _metrics_block() -> dict[str, Any]:
+    """The `metrics` block (both tiers): a registry summary plus the
+    process-health gauges (SystemMonitor ProcessMetrics surfaced through
+    the registry) — the per-process half every scrape also sees."""
+    from ..core.metrics import global_registry
+    from ..core.system_monitor import process_metrics_status
+
+    block = global_registry().status_block()
+    block["process"] = process_metrics_status()
+    return block
+
+
 def _base_status(master, proxy) -> dict[str, Any]:
     """Shared scaffolding of both tiers' status (client block, version
     state, workload totals) — one place to evolve the schema."""
@@ -43,6 +55,7 @@ def _base_status(master, proxy) -> dict[str, Any]:
                     "started": committed + conflicted,
                 }
             },
+            "metrics": _metrics_block(),
         },
     }
 
@@ -264,6 +277,7 @@ def multiprocess_status(host) -> dict[str, Any]:
                 }
             },
             "recruitment": host._recruitment_status(),
+            "metrics": _metrics_block(),
             # Protocol-skew visibility (the typed 1109 path): a mixed-
             # version fleet shows up HERE instead of as a silent
             # reconnect loop in the logs.

@@ -87,6 +87,27 @@ class StorageServer:
         if engine is not None:
             self._restore_durable_state()
 
+    def register_metrics(self, registry=None, labels=()) -> None:
+        """Register this storage server's gauges + read-latency bands on
+        the per-process MetricRegistry (callers pass a `tag` label)."""
+        from ..core.metrics import global_registry
+
+        reg = registry if registry is not None else global_registry()
+        lbl = tuple(labels)
+        reg.register_gauge("storage.data_version",
+                           lambda: self.version.get(),
+                           labels=lbl, replace=True)
+        reg.register_gauge("storage.keys", lambda: len(self.data),
+                           labels=lbl, replace=True)
+        reg.register_gauge("storage.stored_bytes",
+                           lambda: int(self.metrics.byte_sample.total),
+                           labels=lbl, replace=True)
+        reg.register_gauge("storage.watches_count",
+                           lambda: len(self._watches),
+                           labels=lbl, replace=True)
+        reg.register_bands("storage.read_ms", self.read_bands,
+                           labels=lbl, replace=True)
+
     def start(self) -> None:
         from ..core.actors import serve_requests
 

@@ -37,6 +37,37 @@ class MemoryTLog:
         # quorum nor serve peeks.
         self.reachable = True
 
+    def queue_bytes(self) -> int:
+        """Un-popped payload this log holds (ratekeeper/metrics input,
+        ref: TLogQueueInfo). Spilled backlog counts too — the queue does
+        not shrink just because it moved to disk."""
+        total = sum(
+            len(tm.mutation.param1) + len(tm.mutation.param2)
+            for _, tms in self._entries for tm in tms
+        )
+        return total + getattr(self, "spilled_bytes", 0)
+
+    def register_metrics(self, registry=None, labels=()) -> None:
+        """Register this log's gauges on the per-process MetricRegistry
+        (callers pass a `log` label for multi-log fleets)."""
+        from ..core.metrics import global_registry
+
+        reg = registry if registry is not None else global_registry()
+        lbl = tuple(labels)
+        reg.register_gauge("tlog.latest_version",
+                           lambda: self.version.get(),
+                           labels=lbl, replace=True)
+        reg.register_gauge("tlog.durable_version",
+                           lambda: self.durable.get(),
+                           labels=lbl, replace=True)
+        reg.register_gauge(
+            "tlog.queue_entries",
+            lambda: len(self._entries) + getattr(self, "spilled_entries", 0),
+            labels=lbl, replace=True,
+        )
+        reg.register_gauge("tlog.queue_bytes", self.queue_bytes,
+                           labels=lbl, replace=True)
+
     def lock(self, epoch: int) -> int:
         """Epoch end (ref: TagPartitionedLogSystem::epochEnd :107): fence
         out every older generation — their in-flight commits will fail —

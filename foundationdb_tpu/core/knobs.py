@@ -208,6 +208,19 @@ class ServerKnobs(Knobs):
         # commit/resolve latencies bucket into, per role, surfaced in
         # `status json` and over TxnStatusRequest/ResolverStatusRequest.
         init("LATENCY_BAND_EDGES_MS", (1, 2, 5, 10, 25, 50, 100, 250, 1000))
+        # Metrics plane (core/metrics.MetricRegistry; ref: flow/Stats.h +
+        # flow/TDMetric.actor.h): the series sampler's tick interval, how
+        # many ring-buffer samples each resolution retains per metric,
+        # and how many fine ticks make one coarse sample — the
+        # TDMetric-style multi-resolution recent history a scrape
+        # (MetricsRequest series=True / bench.py --commit-plane) returns.
+        init("METRICS_SAMPLE_INTERVAL", 1.0)
+        init("METRICS_SERIES_SAMPLES", 240)
+        init("METRICS_SERIES_COARSE_FACTOR", 30)
+        # MetricLogger retention (cluster/metric_logger.py): \xff/metrics/
+        # time buckets older than this are pruned at each flush, so the
+        # in-database series subspace stops growing without bound.
+        init("METRICS_RETENTION_SECONDS", 900.0, sim_random_range=(5.0, 120.0))
         # Trace-file lifecycle (core/trace.TraceSink; ref: openTraceFile's
         # rollsize/maxLogsSize): per-process trace files roll at this many
         # bytes, keeping the newest TRACE_RETAINED_FILES files (active

@@ -329,6 +329,9 @@ class EventLoop:
         # must never arm it (the emitted events would depend on host
         # speed, breaking the seed-pure event stream).
         self.slow_task_threshold = 0.0
+        # Cumulative SlowTask count (the metrics plane's event-loop
+        # health gauge; stays 0 under sim where detection never arms).
+        self.slow_tasks = 0
         # Optional core.profiler.Profiler whose most recent SIGPROF stack
         # snapshot is attached to SlowTask events (the profiler samples
         # DURING the blocking step; the loop only reads its record).
@@ -431,6 +434,7 @@ class EventLoop:
         cannot."""
         from .trace import SevWarn, TraceEvent
 
+        self.slow_tasks += 1
         ev = TraceEvent("SlowTask", severity=SevWarn).detail(
             "TaskName", task.name
         ).detail("DurationMs", round(seconds * 1e3, 3)).detail(

@@ -8,6 +8,7 @@ reference: totals for monotonic series, rates for gauges."""
 
 from __future__ import annotations
 
+from bisect import bisect_left
 from typing import Optional
 
 from .runtime import Task, current_loop, spawn
@@ -31,6 +32,21 @@ class Counter:
     def __iadd__(self, n: int) -> "Counter":
         self.add(n)
         return self
+
+    # -- windowed-rate accessors (read-only): status/flush code reads the
+    # since-last-flush window through these instead of reaching into
+    # `_window` (the reset stays the flusher's exclusive move).
+    @property
+    def windowed(self) -> int:
+        """Adds since the last `reset_window()` (flush boundary)."""
+        return self._window
+
+    def windowed_rate(self, elapsed: float) -> float:
+        """Rate over the current window, given its elapsed seconds."""
+        return self._window / elapsed if elapsed > 0 else 0.0
+
+    def reset_window(self) -> None:
+        self._window = 0
 
 
 class ContinuousSample:
@@ -95,7 +111,7 @@ class LatencyBands:
     (bands say HOW MANY commits were slow; `cli.py trace` says WHERE one
     of them spent its time)."""
 
-    __slots__ = ("edges_ms", "_counts", "total")
+    __slots__ = ("edges_ms", "_counts", "total", "_exemplars")
 
     def __init__(self, edges_ms=None):
         if edges_ms is None:
@@ -105,12 +121,36 @@ class LatencyBands:
         self.edges_ms = tuple(edges_ms)
         self._counts = [0] * (len(self.edges_ms) + 1)
         self.total = 0
+        # Per-band EXEMPLAR: the most recent flight-recorder debug ID that
+        # landed in the band, so an operator looking at a hot band jumps
+        # straight to `cli.py trace <id>` (the band says HOW MANY were
+        # slow; the exemplar's timeline says WHERE one of them was slow).
+        self._exemplars: dict[int, str] = {}
 
-    def add(self, seconds: float, n: int = 1) -> None:
-        import bisect
+    def _band_label(self, idx: int) -> str:
+        return (f"{self.edges_ms[idx]:g}" if idx < len(self.edges_ms)
+                else "inf")
 
-        self._counts[bisect.bisect_left(self.edges_ms, seconds * 1e3)] += n
+    def add(self, seconds: float, n: int = 1,
+            exemplar: Optional[str] = None) -> None:
+        idx = bisect_left(self.edges_ms, seconds * 1e3)
+        self._counts[idx] += n
         self.total += n
+        if exemplar is not None:
+            self._exemplars[idx] = exemplar
+
+    def clear(self) -> None:
+        """Reset for windowed reporting (a scraper that wants per-window
+        histograms clears after reading; the default consumers read
+        cumulative totals and never call this)."""
+        self._counts = [0] * (len(self.edges_ms) + 1)
+        self.total = 0
+        self._exemplars.clear()
+
+    def exemplars(self) -> dict[str, str]:
+        """{band label: debug id} of the retained per-band exemplars."""
+        return {self._band_label(i): self._exemplars[i]
+                for i in sorted(self._exemplars)}
 
     def status(self) -> dict:
         bands = {}
@@ -119,7 +159,10 @@ class LatencyBands:
             acc += c
             bands[f"{edge:g}"] = acc
         bands["inf"] = self.total
-        return {"bands_ms": bands, "total": self.total}
+        out = {"bands_ms": bands, "total": self.total}
+        if self._exemplars:
+            out["exemplars"] = self.exemplars()
+        return out
 
 
 def stage_percentiles(samples: dict) -> dict:
@@ -225,9 +268,8 @@ class CounterCollection:
         )
         for c in self.counters:
             ev.detail(c.name, c.total)
-            rate = c._window / elapsed if elapsed > 0 else 0.0
-            ev.detail(c.name + "Rate", round(rate, 3))
-            c._window = 0
+            ev.detail(c.name + "Rate", round(c.windowed_rate(elapsed), 3))
+            c.reset_window()
         ev.log()
 
     def start_logging(self, interval: float) -> None:

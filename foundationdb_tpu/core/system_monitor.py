@@ -52,6 +52,9 @@ class SystemMonitor:
         if self._task is not None:
             self._task.cancel()
 
+    def register_metrics(self, registry=None) -> None:
+        return register_process_metrics(registry)
+
     def emit_once(self) -> None:
         loop = current_loop()
         # fdblint: allow[det-wall-clock] -- WallSeconds is operator telemetry only (trace detail); no scheduling or protocol decision reads it, so sim replays stay seed-pure.
@@ -72,3 +75,60 @@ class SystemMonitor:
         while True:
             await loop.delay(self.interval)
             self.emit_once()
+
+
+def register_process_metrics(registry=None) -> None:
+    """Surface ProcessMetrics on the metrics plane: RSS, open FDs, CPU
+    seconds, and the event loop's own health (tasks run, SlowTask
+    count). The OS probes register `volatile=True` — they read host
+    state, so the determinism-covered snapshot form excludes them while
+    scrapes and status json still see them. Idempotent (replace=True):
+    status assembly may call it lazily on any tier."""
+    from .metrics import global_registry
+
+    reg = registry if registry is not None else global_registry()
+    loop = current_loop()
+
+    def probe(key: str, default=0):
+        return lambda: _read_proc_self().get(key, default)
+
+    reg.register_gauge("process.resident_bytes", probe("ResidentBytes"),
+                       volatile=True, replace=True)
+    reg.register_gauge("process.open_fds", probe("OpenFDs"),
+                       volatile=True, replace=True)
+    reg.register_gauge("process.user_cpu_seconds",
+                       probe("UserCPUSeconds", 0.0),
+                       volatile=True, replace=True)
+    reg.register_gauge("process.system_cpu_seconds",
+                       probe("SystemCPUSeconds", 0.0),
+                       volatile=True, replace=True)
+    # Loop health is seed-deterministic under sim (tasks_run counts loop
+    # steps; slow-task detection never arms there) — not volatile.
+    reg.register_gauge("process.loop_tasks_count",
+                       lambda: loop.tasks_run, replace=True)
+    reg.register_gauge("process.slow_tasks_count",
+                       lambda: loop.slow_tasks, replace=True)
+
+
+def process_metrics_status(registry=None) -> dict:
+    """The `metrics.process` block of status json, read THROUGH the
+    registry (registering lazily if this process never started a
+    SystemMonitor) — every key always present so the checked-in status
+    schema can require it."""
+    from .metrics import global_registry
+
+    reg = registry if registry is not None else global_registry()
+    if "process.loop_tasks_count" not in reg:
+        register_process_metrics(reg)
+    vals = {m["name"]: m["value"]
+            for m in reg.snapshot(volatile=True, pattern="process.*")}
+    return {
+        "resident_bytes": int(vals.get("process.resident_bytes") or 0),
+        "open_fds": int(vals.get("process.open_fds") or 0),
+        "user_cpu_seconds": float(vals.get("process.user_cpu_seconds")
+                                  or 0.0),
+        "system_cpu_seconds": float(vals.get("process.system_cpu_seconds")
+                                    or 0.0),
+        "loop_tasks": int(vals.get("process.loop_tasks_count") or 0),
+        "slow_tasks": int(vals.get("process.slow_tasks_count") or 0),
+    }

@@ -202,6 +202,141 @@ async def http_request(host: str, port: int, method: str, path: str,
     return got
 
 
+class TextHTTPServer:
+    """Minimal HTTP/1.0 text server on the loop's reactor (real tier
+    only — the same machinery the client side of this module rides). One
+    render callback serves every GET with a Content-Length'd body and
+    `Connection: close` — exactly the exchange shape `http_request`
+    above expects, and all a Prometheus scraper needs for the
+    `--metrics-port` text exposition endpoint. Every callback is
+    exception-contained: a malformed request fails ITS connection,
+    never the reactor loop."""
+
+    def __init__(self, port: int, render: Callable[[], str],
+                 content_type: str = "text/plain", host: str = "0.0.0.0"):
+        self.port = port
+        self.host = host
+        self.render = render
+        self.content_type = content_type
+        self.reactor = None
+        self._sock: Optional[socket.socket] = None
+        self._conns: dict[int, dict] = {}
+
+    def start(self) -> "TextHTTPServer":
+        loop = current_loop()
+        reactor = getattr(loop, "reactor", None)
+        if reactor is None:
+            raise RuntimeError(
+                "TextHTTPServer needs a real-clock loop+reactor "
+                "(simulated clusters expose metrics via status json / "
+                "MetricsRequest instead)"
+            )
+        self.reactor = reactor
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((self.host, self.port))
+        s.listen(16)
+        s.setblocking(False)
+        self.port = s.getsockname()[1]  # resolved ephemeral port
+        self._sock = s
+        reactor.register_read(s.fileno(), self._on_accept)
+        return self
+
+    def stop(self) -> None:
+        for fd in list(self._conns):
+            self._close(fd)
+        if self._sock is not None:
+            self.reactor.unregister(self._sock.fileno())
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _close(self, fd: int) -> None:
+        st = self._conns.pop(fd, None)
+        if st is None:
+            return
+        self.reactor.unregister(fd)
+        try:
+            st["conn"].close()
+        except OSError:
+            pass
+
+    def _on_accept(self) -> None:
+        try:
+            conn, _addr = self._sock.accept()
+        except (BlockingIOError, InterruptedError, OSError):
+            return
+        conn.setblocking(False)
+        fd = conn.fileno()
+        st = {"conn": conn, "buf": bytearray(), "out": b""}
+        self._conns[fd] = st
+        self.reactor.register_read(fd, lambda: self._on_read(fd))
+
+    def _respond(self, st: dict) -> bytes:
+        head = bytes(st["buf"]).split(b"\r\n", 1)[0].decode(
+            "latin-1", "replace"
+        )
+        parts = head.split()
+        if len(parts) < 2 or parts[0] not in ("GET", "HEAD"):
+            body = b"method not allowed\n"
+            status = "405 Method Not Allowed"
+            ctype = "text/plain"
+        else:
+            try:
+                body = self.render().encode()
+                status = "200 OK"
+                ctype = self.content_type
+            except Exception as e:  # noqa: BLE001 - contain to the request
+                body = f"render failed: {type(e).__name__}: {e}\n".encode()
+                status = "500 Internal Server Error"
+                ctype = "text/plain"
+        if parts and parts[0] == "HEAD":
+            payload = b""
+        else:
+            payload = body
+        return (
+            f"HTTP/1.0 {status}\r\n"
+            f"Content-Type: {ctype}\r\n"
+            f"Content-Length: {len(body)}\r\n"
+            "Connection: close\r\n\r\n"
+        ).encode() + payload
+
+    def _on_read(self, fd: int) -> None:
+        st = self._conns.get(fd)
+        if st is None:
+            return
+        try:
+            try:
+                chunk = st["conn"].recv(1 << 16)
+            except (BlockingIOError, InterruptedError):
+                return
+            if chunk:
+                st["buf"].extend(chunk)
+            if b"\r\n\r\n" in st["buf"] or not chunk:
+                st["out"] = self._respond(st)
+                self.reactor.unregister_read(fd)
+                self.reactor.register_write(fd, lambda: self._on_write(fd))
+        except BaseException:  # noqa: BLE001 - contain to the connection
+            self._close(fd)
+
+    def _on_write(self, fd: int) -> None:
+        st = self._conns.get(fd)
+        if st is None:
+            return
+        try:
+            try:
+                n = st["conn"].send(st["out"])
+            except (BlockingIOError, InterruptedError):
+                return
+            st["out"] = st["out"][n:]
+            if not st["out"]:
+                self._close(fd)
+        except BaseException:  # noqa: BLE001 - contain to the connection
+            self._close(fd)
+
+
 def http_request_sync(host: str, port: int, method: str, path: str,
                       headers: Optional[dict] = None, body: bytes = b"",
                       timeout: float | None = None) -> HTTPResponse:

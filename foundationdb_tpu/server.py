@@ -101,10 +101,17 @@ def _mode_replicas(mode: str) -> int:
     return policy_for_mode(mode).num_replicas()
 
 
-def run_fdbd(sharded: bool, log_replication: str = "single") -> int:
+def run_fdbd(sharded: bool, log_replication: str = "single",
+             metrics_port: int = 0) -> int:
     from .core.runtime import EventLoop, loop_context
 
     loop = EventLoop()
+    if metrics_port:
+        # The exposition endpoint rides the loop's reactor; the embedded
+        # fdbd has no transport, so attach one just for it.
+        from .net.reactor import SelectReactor
+
+        loop.reactor = SelectReactor()
     with loop_context(loop):
         if sharded:
             from .cluster.sharded_cluster import ShardedKVCluster
@@ -117,6 +124,20 @@ def run_fdbd(sharded: bool, log_replication: str = "single") -> int:
             from .cluster.cluster import LocalCluster
 
             cluster = LocalCluster().start()
+        if metrics_port:
+            from .core.metrics import global_registry
+            from .core.system_monitor import register_process_metrics
+            from .net.http import TextHTTPServer
+
+            registry = global_registry()
+            register_process_metrics(registry)
+            registry.start_sampler()
+            http_metrics = TextHTTPServer(
+                metrics_port, lambda: registry.prometheus_text(),
+                content_type="text/plain; version=0.0.4",
+            ).start()
+            print(f"fdbtpu: metrics exposition on :{http_metrics.port}"
+                  "/metrics", file=sys.stderr)
         print("fdbtpu: cluster serving (ctrl-c to stop)", file=sys.stderr)
 
         async def serve_forever():
@@ -155,7 +176,8 @@ def run_role_host(args) -> int:
     threading.Thread(target=announce, daemon=True).start()
     _run(args.process_class, args.cluster_file, args.datadir,
          ready=ready, stop_event=stop, machine_id=args.machine_id or "",
-         trace_dir=args.trace_dir or "")
+         trace_dir=args.trace_dir or "",
+         metrics_port=args.metrics_port or 0)
     return 0
 
 
@@ -210,6 +232,12 @@ def main(argv=None) -> int:
                          "rolling trace files (trace-<class>.jsonl; "
                          "default: <datadir>/trace.jsonl). The spec's "
                          "trace_dir key sets it fleet-wide.")
+    ap.add_argument("--metrics-port", type=int, default=0,
+                    help="serve the Prometheus text exposition of this "
+                         "process's MetricRegistry over HTTP on this "
+                         "port (real tier: fdbd and --class role hosts; "
+                         "0 = off; the spec's metrics_ports map sets it "
+                         "per class fleet-wide)")
     ap.add_argument("--knob", action="append", default=[],
                     metavar="NAME=VALUE", help="set a knob (repeatable)")
     args = ap.parse_args(argv)
@@ -236,7 +264,8 @@ def main(argv=None) -> int:
     if args.log_replication != "single" and not args.sharded:
         ap.error("--log-replication requires --sharded (the one-process "
                  "cluster has a single log)")
-    return run_fdbd(args.sharded, log_replication=args.log_replication)
+    return run_fdbd(args.sharded, log_replication=args.log_replication,
+                    metrics_port=args.metrics_port)
 
 
 if __name__ == "__main__":

@@ -96,6 +96,10 @@ _KNOB_RANGES = [
     # micro-event emission points and the wire debug columns run inside
     # the determinism contract (same seed => bit-identical event chain).
     ("COMMIT_SAMPLE_RATE", "client", (0.0, 1.0)),
+    # r13: MetricLogger retention — low draws prune \xff/metrics/ time
+    # buckets aggressively mid-workload, so the clear_range prune path
+    # runs inside the chaos mix instead of only at operator horizons.
+    ("METRICS_RETENTION_SECONDS", "server", (5.0, 120.0)),
 ]
 
 # Categorical knob draws (same subset-randomization policy as the ranges).

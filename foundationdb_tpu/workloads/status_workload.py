@@ -30,6 +30,24 @@ _NUM = (int, float)
 
 LATENCY_BANDS_SCHEMA = {"bands_ms": dict, "total": int}
 
+# The cluster-wide `metrics` block (cluster/status._metrics_block): the
+# MetricRegistry summary plus the SystemMonitor ProcessMetrics surfaced
+# through it — validated mid-chaos so a status refactor cannot silently
+# drop the process-health gauges the scrape plane also serves.
+METRICS_SCHEMA = {
+    "registered_count": int,
+    "kinds": dict,
+    "series_ticks": int,
+    "process": {
+        "resident_bytes": int,
+        "open_fds": int,
+        "user_cpu_seconds": _NUM,
+        "system_cpu_seconds": _NUM,
+        "loop_tasks": int,
+        "slow_tasks": int,
+    },
+}
+
 PROXY_ROLE_SCHEMA = {
     "role": str,
     "txns_committed": int,
@@ -80,6 +98,7 @@ STATUS_SCHEMA = {
             "transactions": {"committed": int, "conflicted": int,
                              "started": int},
         },
+        "metrics": METRICS_SCHEMA,
         "roles": ("list_of", {"role": str}),
     },
 }

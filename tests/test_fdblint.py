@@ -570,6 +570,53 @@ def test_trace_unlogged_scoped_to_project(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# pack: metrics-plane naming
+# ---------------------------------------------------------------------------
+
+def test_metric_name_format_bad_grammar(tmp_path):
+    fs = run_lint(tmp_path, {SIM: """
+        def f(reg, c):
+            reg.register_counter("TxnsCommitted", c)
+            reg.register_counter("proxy", c)
+            reg.register_gauge("proxy.Queue.bytes", lambda: 0)
+    """})
+    assert [f.rule for f in fs if not f.suppressed] \
+        == ["metric-name-format"] * 3
+
+
+def test_metric_name_format_missing_unit_suffix(tmp_path):
+    fs = run_lint(tmp_path, {SIM: """
+        def f(reg, b, s):
+            reg.register_gauge("tlog.queue", lambda: 0)
+            reg.register_bands(name="proxy.commit_latency", bands=b)
+            reg.register_sample("resolver.stage", s)
+    """})
+    assert [f.rule for f in fs if not f.suppressed] \
+        == ["metric-name-format"] * 3
+
+
+def test_metric_name_format_good_names(tmp_path):
+    fs = run_lint(tmp_path, {SIM: """
+        def f(reg, c, b, s, sm):
+            reg.register_counter("proxy.txns_committed", c)
+            reg.register_gauge("tlog.queue_bytes", lambda: 0)
+            reg.register_bands("proxy.commit_ms", b)
+            reg.register_sample("resolver.stage_ms", s)
+            reg.register_smoother("ratekeeper.smoothed_lag_versions", sm)
+            reg.register_gauge(dynamic_name(), lambda: 0)  # runtime's job
+    """})
+    assert rules_of(fs) == []
+
+
+def test_metric_name_format_scoped_to_project(tmp_path):
+    fs = run_lint(tmp_path, {"tests/helper.py": """
+        def f(reg, c):
+            reg.register_counter("BadName", c)
+    """})
+    assert rules_of(fs) == []
+
+
+# ---------------------------------------------------------------------------
 # pragmas, baseline, output modes
 # ---------------------------------------------------------------------------
 

@@ -51,6 +51,7 @@ RULES: dict[str, str] = {
     "jax-host-sync": "host sync (np.asarray, .block_until_ready) inside a jitted function",
     "jax-pipeline-sync": "host sync (np.asarray, .block_until_ready) on an in-flight resolve handle outside the designated verdict-consumption sites",
     "trace-unlogged": "TraceEvent constructed as a dropped expression (chain not ending in .log(), not a context manager, not returned) — a silently lost diagnostic",
+    "metric-name-format": "metric registered under a name that is not a snake_case dotted path, or a non-counter without a unit suffix (duplicate registration is separately a startup error in the registry)",
     "wire-raw-protocol-version": "raw u64(PROTOCOL_VERSION)-style version write outside core/serialize.py — bypasses write_protocol_version and the compatibility lattice",
     "knob-undeclared": "SERVER_KNOBS/CLIENT_KNOBS reference with no declaration in core/knobs.py",
     "knob-dead": "knob declared in core/knobs.py but referenced nowhere",
@@ -258,6 +259,7 @@ def lint_paths(paths: Iterable[str], root: Optional[str] = None,
         rules_determinism,
         rules_jax,
         rules_knobs,
+        rules_metrics,
         rules_trace,
         rules_wire,
     )
@@ -269,7 +271,7 @@ def lint_paths(paths: Iterable[str], root: Optional[str] = None,
     for ctx in ctxs:
         findings.extend(ctx.pragma_findings)
         for pack in (rules_determinism, rules_async, rules_jax,
-                     rules_trace, rules_wire):
+                     rules_trace, rules_wire, rules_metrics):
             findings.extend(pack.check(ctx))
     findings.extend(rules_knobs.check_project(ctxs))
     findings.extend(rules_jax.check_project(ctxs))

@@ -1,0 +1,81 @@
+"""Rule pack — metrics-plane naming.
+
+``metric-name-format``: a literal name passed to a MetricRegistry
+registration method (``register_counter`` / ``register_gauge`` /
+``register_sample`` / ``register_bands`` / ``register_smoother``) must
+be a snake_case DOTTED path (at least two segments), and every
+non-counter instrument's last name token must be a unit suffix from the
+shared set — so a scraper can always tell bytes from versions from
+milliseconds without a lookup table. The registry enforces the same
+grammar at runtime (core/metrics.validate_name — a bad name or a
+duplicate (name, labels) registration is a STARTUP error); this rule
+catches the literal sites statically, before any process boots.
+
+Scoped to ``foundationdb_tpu/`` like the determinism pack: test
+fixtures register bad names deliberately.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import FileCtx, Finding
+
+_REGISTER_METHODS = {
+    "register_counter", "register_gauge", "register_sample",
+    "register_bands", "register_smoother",
+}
+
+# Kept in sync with foundationdb_tpu/core/metrics.py UNIT_SUFFIXES
+# (asserted by tests/test_metrics.py::test_lint_unit_suffixes_in_sync).
+UNIT_SUFFIXES = (
+    "ms", "seconds", "bytes", "versions", "version", "count", "total",
+    "depth", "tps", "keys", "entries", "fds", "ratio",
+)
+
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z][a-z0-9_]*)+$")
+
+
+def _literal_name(call: ast.Call):
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        return call.args[0].value
+    for kw in call.keywords:
+        if kw.arg == "name" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str):
+            return kw.value.value
+    return None
+
+
+def check(ctx: FileCtx) -> list[Finding]:
+    if not ctx.path.startswith("foundationdb_tpu/"):
+        return []
+    findings: list[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) \
+                or not isinstance(node.func, ast.Attribute) \
+                or node.func.attr not in _REGISTER_METHODS:
+            continue
+        name = _literal_name(node)
+        if name is None:
+            continue  # dynamic names are the runtime check's job
+        if not _NAME_RE.match(name):
+            findings.append(Finding(
+                ctx.path, node.lineno, "metric-name-format",
+                f"metric name {name!r} is not a snake_case dotted path "
+                "(expected e.g. 'proxy.txns_committed')",
+                end_line=getattr(node, "end_lineno", node.lineno),
+            ))
+            continue
+        if node.func.attr != "register_counter":
+            last = name.rsplit(".", 1)[-1].rsplit("_", 1)[-1]
+            if last not in UNIT_SUFFIXES:
+                findings.append(Finding(
+                    ctx.path, node.lineno, "metric-name-format",
+                    f"non-counter metric {name!r} lacks a unit suffix "
+                    f"(last name token must be one of "
+                    f"{', '.join(UNIT_SUFFIXES)})",
+                    end_line=getattr(node, "end_lineno", node.lineno),
+                ))
+    return findings
