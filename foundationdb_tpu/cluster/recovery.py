@@ -113,7 +113,27 @@ def _send_recovery_txn(commit_ref, start_version: int) -> None:
     ))
 
 
-class RecoverableCluster:
+class _RecoveryStateRecorder:
+    """Coverage hook shared by the recoverable tiers: `recovery_state`
+    stays a plain read/write attribute, but every state the incarnation
+    ever enters is also recorded (first-entry order) in
+    `recovery_states_seen` — workloads/tester.py folds the set into the
+    per-spec coverage summary, where the swarm's signature buckets on
+    which recovery phases a seed actually reached."""
+
+    @property
+    def recovery_state(self) -> str:
+        return self.__dict__.get("_recovery_state", "booting")
+
+    @recovery_state.setter
+    def recovery_state(self, value: str) -> None:
+        self.__dict__["_recovery_state"] = value
+        seen = self.__dict__.setdefault("recovery_states_seen", [])
+        if value not in seen:
+            seen.append(value)
+
+
+class RecoverableCluster(_RecoveryStateRecorder):
     """A cluster whose transaction system can die and be re-recruited.
 
     The storage node and the log are long-lived; master/proxy/resolver/
@@ -342,7 +362,7 @@ class RecoverableCluster:
         return got is not None
 
 
-class RecoverableShardedCluster:
+class RecoverableShardedCluster(_RecoveryStateRecorder):
     """Recovery generations over the SHARDED tier: the tag-partitioned
     log system and the storage fleet are long-lived; master / resolver /
     proxy / ratekeeper are per-generation, re-recruited by the controller

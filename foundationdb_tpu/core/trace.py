@@ -162,6 +162,14 @@ class TraceSink:
         return (self._type_counts.get(event_type, 0)
                 - self.suppressed.get(event_type, 0))
 
+    def type_counts(self) -> dict[str, int]:
+        """Per-type accepted totals for every event type this sink ever
+        saw — the coverage-signature feed (workloads/tester.py): the TYPE
+        SET is what the swarm buckets on, and it survives window trims
+        and flood suppression by construction."""
+        return {t: n - self.suppressed.get(t, 0)
+                for t, n in self._type_counts.items()}
+
     def find(self, event_type: str) -> TraceFindResult:
         """Matching events still in the in-memory window. The result's
         `truncated` attribute is the number of matching events the memory

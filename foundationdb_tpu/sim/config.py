@@ -25,8 +25,9 @@ any failure reproduces from the seed alone (run_spec is deterministic).
 
 from __future__ import annotations
 
+import hashlib
 import random
-from typing import Any
+from typing import Any, Optional
 
 # (knob name, which registry, (lo, hi)) — randomization ranges for knobs
 # governing behavior the repo actually has. Ints randomize inclusive.
@@ -120,18 +121,184 @@ _KNOB_CHOICES = [
 _REPLICATION_FOR = {3: ["single", "double", "triple"],
                     2: ["single", "double"], 1: ["single"]}
 
+# Dimensions a DrawBias may steer, with the option set each one draws
+# over (tools/swarm.py ranks these by coverage-facet saturation and
+# prefers the least-seen value). `bias_facet` maps a (dim, option) to
+# the facet string `coverage_facets` emits for it, so the swarm's
+# corpus arithmetic and the signature stay keyed identically.
+BIAS_DIMS: dict[str, tuple] = {
+    "kind": ("recoverable_sharded", "sharded"),
+    "engine": (None, "memory", "ssd"),
+    "replication": ("single", "double", "triple"),
+    "topology_dcs": (None, 1, 2, 3),
+    "regions": (False, True),
+}
 
-def generate_config(seed: int) -> dict[str, Any]:
+_BUCKETS = ("lo", "mid", "hi")
+
+# The shape-agnostic optional pool a DrawBias "workload" preference can
+# force-include (kept in sync with the `optional` list below; the
+# gated stanzas — attrition/topology/backup nemeses — stay draw-only).
+OPTIONAL_WORKLOAD_NAMES = (
+    "Serializability", "Watches", "ConflictRange", "WriteDuringRead",
+    "FuzzApi", "VersionStamp", "BackupRestore", "StatusWorkload",
+    "Increment", "LowLatency",
+)
+
+
+def bias_facet(dim: str, value) -> str:
+    """The coverage facet a biasable dimension's option lands in."""
+    if dim == "topology_dcs":
+        return f"shape.n_dcs={'none' if value is None else value}"
+    if dim == "engine":
+        return f"shape.engine={value or 'none'}"
+    return f"shape.{dim}={value}"
+
+
+class DrawBias:
+    """Coverage-guided preferences for `generate_config` draws.
+
+    The swarm (tools/swarm.py) builds one per seed from its corpus of
+    seen coverage facets and passes it in; the generator then steers a
+    draw toward the preferred value with probability `strength`, leaving
+    the rest of the seed's draw stream untouched. The OUTPUT spec is
+    still the full repro on its own — `run_spec` never sees the bias.
+
+    prefer        dim (BIAS_DIMS key, or "workload") -> preferred value.
+    strength      probability a preference overrides the unbiased draw.
+    force_knobs   knob keys ("server:NAME") whose override is always
+                  drawn (the unbiased path includes each with p=0.5).
+    knob_buckets  knob key -> "lo"|"mid"|"hi" (range knobs: the drawn
+                  value lands in that third of the range) or a literal
+                  categorical choice.
+    allow_engine_topology
+                  opens the durable-engine x machine-topology joint
+                  space, mutually exclusive in the unbiased draw
+                  (ROADMAP scenario-diversity leftover (b)); gated here
+                  so only the swarm explores it until it graduates.
+    """
+
+    def __init__(self, prefer: Optional[dict] = None,
+                 strength: float = 0.75,
+                 force_knobs=(), knob_buckets: Optional[dict] = None,
+                 allow_engine_topology: bool = False):
+        self.prefer = dict(prefer or {})
+        self.strength = strength
+        self.force_knobs = set(force_knobs)
+        self.knob_buckets = dict(knob_buckets or {})
+        self.allow_engine_topology = allow_engine_topology
+
+
+_MISS = object()
+
+
+def _steer(rng: random.Random, bias: Optional[DrawBias], dim: str,
+           drawn, options) -> Any:
+    """Return the unbiased `drawn` value, or — when the bias prefers a
+    feasible option for `dim` — that option with p=strength. Consumes
+    one extra rng draw ONLY on biased dims, so bias=None reproduces the
+    historical draw stream bit-for-bit."""
+    if bias is None:
+        return drawn
+    pref = bias.prefer.get(dim, _MISS)
+    if pref is _MISS or pref not in options:
+        return drawn
+    return pref if rng.random() < bias.strength else drawn
+
+
+def knob_bucket(key: str, value) -> str:
+    """Coverage bucket of a knob override: lo/mid/hi third of its draw
+    range, or the literal value for categorical knobs (unknown keys
+    bucket by raw value — hand-written specs may override anything)."""
+    reg, _, name = key.partition(":")
+    for n, r, (lo, hi) in _KNOB_RANGES:
+        if n == name and r == reg:
+            try:
+                frac = (float(value) - lo) / ((hi - lo) or 1)
+            except (TypeError, ValueError):
+                return str(value)
+            return _BUCKETS[min(2, max(0, int(frac * 3)))]
+    return str(value)
+
+
+def _bucket_span(lo, hi, bucket: str):
+    """The [blo, bhi] sub-range of a knob's draw range that `knob_bucket`
+    maps back to `bucket` (used by biased draws to land inside it)."""
+    b = _BUCKETS.index(bucket)
+    if isinstance(lo, int):
+        span = hi - lo + 1
+        blo = lo + span * b // 3
+        bhi = min(hi, lo + span * (b + 1) // 3 - 1)
+        return blo, max(blo, bhi)
+    width = (hi - lo) / 3
+    return lo + width * b, lo + width * (b + 1)
+
+
+def coverage_facets(spec: dict, result: Optional[dict] = None) -> list[str]:
+    """The per-seed coverage signature's bucket set: cluster-shape draw,
+    knob buckets, workload mix, and — when a run result is supplied —
+    the trace event types, recovery states, and metric-snapshot names
+    the run actually reached (workloads/tester.py emits all three
+    deterministically in results["coverage"]). Sorted, printable, and
+    stable across reruns of the same seed: signature divergence between
+    two runs of one spec is a determinism bug."""
+    facets: set[str] = set()
+    cluster = spec.get("cluster", {})
+    topo = cluster.get("topology")
+    facets.add(f"shape.kind={cluster.get('kind', 'local')}")
+    facets.add(f"shape.engine={cluster.get('engine') or 'none'}")
+    facets.add(f"shape.replication={cluster.get('replication', 'single')}")
+    facets.add("shape.log_replication="
+               f"{cluster.get('log_replication', 'single')}")
+    facets.add(f"shape.regions={bool(cluster.get('regions'))}")
+    facets.add("shape.n_dcs="
+               f"{topo['n_dcs'] if topo else 'none'}")
+    facets.add("shape.topology=" + (
+        f"{topo['n_dcs']}x{topo['machines_per_dc']}" if topo else "none"))
+    facets.add("shape.engine_topology="
+               f"{cluster.get('engine') is not None and topo is not None}")
+    facets.add(f"shape.n_storage={cluster.get('n_storage', 1)}")
+    facets.add(f"shape.n_logs={cluster.get('n_logs', 1)}")
+    for key in sorted(spec.get("knobs") or {}):
+        facets.add(f"knob.{key}={knob_bucket(key, spec['knobs'][key])}")
+    stanzas = list(spec.get("workloads", []))
+    for phase in spec.get("phases", []):
+        stanzas.extend(phase.get("workloads", []))
+    for w in stanzas:
+        facets.add(f"wl.{w.get('name', '?')}")
+    cov = (result or {}).get("coverage") or {}
+    for t in cov.get("trace_event_types", ()):
+        facets.add(f"ev.{t}")
+    for s in cov.get("recovery_states", ()):
+        facets.add(f"rs.{s}")
+    for m in cov.get("metric_names", ()):
+        facets.add(f"metric.{m}")
+    return sorted(facets)
+
+
+def coverage_signature(spec: dict, result: Optional[dict] = None) -> str:
+    """Stable digest of `coverage_facets` — the corpus key one run
+    occupies. Same seed (and binary) => same signature; tools/swarm.py's
+    --check-determinism compares it alongside the keyspace fingerprint."""
+    facets = coverage_facets(spec, result)
+    return hashlib.sha256("\n".join(facets).encode()).hexdigest()[:16]
+
+
+def generate_config(seed: int, bias: Optional[DrawBias] = None
+                    ) -> dict[str, Any]:
     rng = random.Random(seed)
     n_storage = rng.randint(3, 6)
     n_logs = rng.randint(1, 3)
     replication = rng.choice(_REPLICATION_FOR[min(n_storage, 3)])
+    replication = _steer(rng, bias, "replication", replication,
+                         _REPLICATION_FOR[min(n_storage, 3)])
     # Cluster KIND is a per-seed draw too (ref: SimulatedCluster's
     # simple/fearless/with-resolvers configuration draws): most seeds
     # run the recoverable tier (attrition-capable), a minority pin the
     # plain sharded data plane where the generation machinery is absent
     # by construction.
     kind = "recoverable_sharded" if rng.random() < 0.75 else "sharded"
+    kind = _steer(rng, bias, "kind", kind, BIAS_DIMS["kind"])
     # Storage ENGINE + durability draw (ref: SimulationConfig's
     # storage-engine randomization, SimulatedCluster.actor.cpp:696):
     # some seeds run the whole chaos mix over a durable datadir — tlogs
@@ -142,6 +309,7 @@ def generate_config(seed: int) -> dict[str, Any]:
     engine = None
     if rng.random() < 0.25:
         engine = rng.choice(["memory", "memory", "ssd"])
+    engine = _steer(rng, bias, "engine", engine, BIAS_DIMS["engine"])
 
     # Machine/DC topology (sim/topology.py), drawn per seed like the
     # reference's machine/datacenter counts (SimulatedCluster's
@@ -150,13 +318,26 @@ def generate_config(seed: int) -> dict[str, Any]:
     # Needs at least as many machines as the replication factor or the
     # policy is unsatisfiable by construction.
     topology = None
-    if rng.random() < 0.5 and kind == "recoverable_sharded" \
-            and engine is None:
+    # Unbiased draws keep durable engines OUT of machine-blackout
+    # scenarios (power-loss over a durable fleet is the restart specs'
+    # subject); a DrawBias with allow_engine_topology opens the joint
+    # engine x topology space for the swarm (machine kills/reboots on a
+    # durable fleet run WITHOUT power_loss, so the datadir survives).
+    topo_ok = kind == "recoverable_sharded" and (
+        engine is None
+        or (bias is not None and bias.allow_engine_topology))
+    want_topo = rng.random() < 0.5 and topo_ok
+    pref_dcs = bias.prefer.get("topology_dcs", _MISS) if bias else _MISS
+    forced_dcs = None
+    if pref_dcs is not _MISS and rng.random() < bias.strength:
+        if pref_dcs is None:
+            want_topo = False
+        elif topo_ok:
+            want_topo, forced_dcs = True, pref_dcs
+    if want_topo:
         # The machine nemesis needs the recoverable tier (sim_topology
-        # only attaches there), and the durable draw keeps real files
-        # out of machine-blackout scenarios (power-loss over a durable
-        # fleet is the restart specs' subject).
-        n_dcs = rng.choice([1, 1, 2, 3])
+        # only attaches there).
+        n_dcs = forced_dcs or rng.choice([1, 1, 2, 3])
         machines_per_dc = rng.randint(2, 4)
         need = {"single": 1, "double": 2, "triple": 3}[replication]
         while n_dcs * machines_per_dc < need:
@@ -169,9 +350,10 @@ def generate_config(seed: int) -> dict[str, Any]:
     # DC-spanning mode so a whole-DC kill stays inside what the team
     # policy survives (and the MachineAttrition dc_kill draw can land).
     regions = False
-    if topology is not None and topology["n_dcs"] >= 2 \
-            and rng.random() < 0.4:
-        regions = True
+    if topology is not None and topology["n_dcs"] >= 2:
+        regions = rng.random() < 0.4
+        regions = _steer(rng, bias, "regions", regions, (False, True))
+    if regions:
         replication = "two_datacenter"
 
     # k-way log replication, constrained by how many distinct failure
@@ -193,16 +375,25 @@ def generate_config(seed: int) -> dict[str, Any]:
 
     knobs: dict[str, Any] = {}
     for name, reg, (lo, hi) in _KNOB_RANGES:
-        if rng.random() < 0.5:
-            continue  # leave at default (the reference randomizes subsets)
-        if isinstance(lo, int):
-            knobs[f"{reg}:{name}"] = rng.randint(lo, hi)
-        else:
-            knobs[f"{reg}:{name}"] = round(lo + rng.random() * (hi - lo), 5)
-    for name, reg, choices in _KNOB_CHOICES:
-        if rng.random() < 0.5:
+        key = f"{reg}:{name}"
+        skip = rng.random() < 0.5  # leave at default (the reference
+        #                            randomizes subsets)
+        if skip and not (bias is not None and key in bias.force_knobs):
             continue
-        knobs[f"{reg}:{name}"] = rng.choice(choices)
+        bucket = bias.knob_buckets.get(key) if bias is not None else None
+        blo, bhi = (_bucket_span(lo, hi, bucket)
+                    if bucket in _BUCKETS else (lo, hi))
+        if isinstance(lo, int):
+            knobs[key] = rng.randint(blo, bhi)
+        else:
+            knobs[key] = round(blo + rng.random() * (bhi - blo), 5)
+    for name, reg, choices in _KNOB_CHOICES:
+        key = f"{reg}:{name}"
+        skip = rng.random() < 0.5
+        if skip and not (bias is not None and key in bias.force_knobs):
+            continue
+        bucket = bias.knob_buckets.get(key) if bias is not None else None
+        knobs[key] = bucket if bucket in choices else rng.choice(choices)
 
     workloads: list[dict[str, Any]] = [
         {"name": "Cycle", "nodes": rng.randint(8, 24),
@@ -221,9 +412,22 @@ def generate_config(seed: int) -> dict[str, Any]:
         {"name": "BackupRestore", "snapshots": 2},
         {"name": "StatusWorkload", "fetches": rng.randint(3, 8),
          "interval": round(0.1 + 0.4 * rng.random(), 2)},
+        # Reference-corpus round 3 (ROADMAP scenario diversity (a)):
+        # Increment's atomic-add ledger and LowLatency's bounded-GRV
+        # probe loop, both shape-agnostic.
+        {"name": "Increment", "clients": rng.randint(2, 4),
+         "txns": rng.randint(8, 20), "key_space": rng.randint(4, 12)},
+        {"name": "LowLatency", "probes": rng.randint(6, 14),
+         "interval": round(0.1 + 0.3 * rng.random(), 2),
+         "max_latency": 5.0},
     ]
     rng.shuffle(optional)
-    workloads.extend(optional[: rng.randint(1, 3)])
+    chosen = optional[: rng.randint(1, 3)]
+    pref_wl = bias.prefer.get("workload", _MISS) if bias else _MISS
+    if pref_wl is not _MISS and rng.random() < bias.strength \
+            and pref_wl not in {w["name"] for w in chosen}:
+        chosen.extend(w for w in optional if w["name"] == pref_wl)
+    workloads.extend(chosen)
     # TaskBucket lease-takeover soak: mortal backup agents + a killing
     # nemesis, any cluster kind.
     if rng.random() < 0.25:

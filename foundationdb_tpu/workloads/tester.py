@@ -405,6 +405,58 @@ async def _run_workloads(cluster, db, spec) -> dict[str, Any]:
             checkers.append((rkey, wl.check,
                              lambda wl=wl: {"fetches": wl.fetches_done,
                                             "violations": wl.failures[:3]}))
+        elif name == "Increment":
+            # Atomic-add ledger whose grand total must balance exactly
+            # (ref: Increment.actor.cpp) — reference-corpus round 3.
+            from .increment import IncrementWorkload
+
+            wl = IncrementWorkload(db, key_space=w.get("key_space", 8))
+            starters.append((rkey, spawn(wl.run(
+                clients=w.get("clients", 3),
+                txns_per_client=w.get("txns", 15),
+            )).done))
+            checkers.append((rkey, wl.check,
+                             lambda wl=wl: {"txns": wl.txns_done,
+                                            "ambiguous": wl.ambiguous,
+                                            "retries": wl.retries}))
+        elif name == "LowLatency":
+            # Bounded-latency GRV+read canary probing WHILE the spec's
+            # nemeses run (ref: LowLatency.actor.cpp); probes that ride
+            # through a recovery are exempt from the bound.
+            from .low_latency import LowLatencyWorkload
+
+            wl = LowLatencyWorkload(
+                db, cluster=cluster, probes=w.get("probes", 10),
+                interval=w.get("interval", 0.3),
+                max_latency=w.get("max_latency", 5.0),
+            )
+            starters.append((rkey, spawn(wl.run()).done))
+            checkers.append((rkey, wl.check, wl.metrics))
+        elif name == "SyntheticFault":
+            # Deliberate, deterministic failure injection for the swarm
+            # machinery itself (tools/swarm.py + tools/distill.py): the
+            # distiller and the regression-corpus replay need a failure
+            # that is a pure function of the spec. Modes map onto the
+            # three failure classes the sweep distinguishes: "crash"
+            # raises out of the spec, "sev_error" emits a SevError trace
+            # event, "check_fail" (default) fails its check phase.
+            mode = w.get("mode", "check_fail")
+            if w.get("arm", True) and mode == "crash":
+                raise RuntimeError("SyntheticFault: injected crash")
+
+            async def _synthetic_check(mode=mode, armed=w.get("arm", True)):
+                if not armed:
+                    return True
+                if mode == "sev_error":
+                    TraceEvent("SyntheticFault", severity=40).detail(
+                        "Mode", mode
+                    ).log()
+                    return True
+                return False
+
+            checkers.append((rkey, _synthetic_check,
+                             lambda w=w: {"mode": w.get("mode",
+                                                        "check_fail")}))
         elif name == "DataDistribution":
             dd = cluster.start_data_distribution(
                 interval=w.get("interval", 0.2)
@@ -472,7 +524,26 @@ async def _run_workloads(cluster, db, spec) -> dict[str, Any]:
         # is checked by comparing this across reruns.
         results["fingerprint"] = await _keyspace_fingerprint(cluster)
     results["ok"] = ok
+    results["coverage"] = _coverage_summary(cluster)
     return results
+
+
+def _coverage_summary(cluster) -> dict[str, Any]:
+    """Structured per-run coverage: the trace event types the run emitted,
+    the recovery states the cluster passed through, and the metric names
+    registered on this loop's registry — all deterministic per seed, the
+    raw material of the swarm's coverage signature
+    (sim/config.coverage_facets folds these in alongside the spec's
+    shape/knob/workload draws)."""
+    from ..core.metrics import global_registry
+
+    return {
+        "trace_event_types": sorted(global_sink().type_counts()),
+        "recovery_states": sorted(
+            getattr(cluster, "recovery_states_seen", ())
+        ),
+        "metric_names": sorted(global_registry().names()),
+    }
 
 
 async def _keyspace_fingerprint(cluster) -> str:
@@ -683,6 +754,14 @@ def run_restart_spec(spec: dict) -> dict[str, Any]:
     results["sev_error_events"] = [
         e for p in results["phases"] for e in p.get("sev_error_events", [])
     ][:50]
+    # Coverage union across incarnations: the restart spec's signature
+    # reflects everything ANY phase reached (phases that refused to boot
+    # contribute nothing, which is itself signature-visible).
+    results["coverage"] = {
+        key: sorted({v for p in results["phases"]
+                     for v in p.get("coverage", {}).get(key, ())})
+        for key in ("trace_event_types", "recovery_states", "metric_names")
+    }
     if owns_datadir:
         # Sweep hygiene: a datadir nobody named is a per-run scratch
         # disk (each rerun cold-boots a fresh one by construction).
@@ -690,6 +769,60 @@ def run_restart_spec(spec: dict) -> dict[str, Any]:
 
         shutil.rmtree(datadir, ignore_errors=True)
     return results
+
+
+def failure_summary(spec: dict, res: dict) -> dict[str, Any]:
+    """Classify one spec run into a structured failure summary whose
+    `class` string is the distiller's shrink-preserving fingerprint
+    (tools/distill.py accepts a shrunken candidate only when the class
+    survives; tools/swarm.py and tools/seed_sweep.py gate seeds on it).
+
+    Classes, most- to least-specific:
+      crash:<ExcType>   the run raised out of run_spec (res carries an
+                        "error" string, "TypeName: message")
+      sev:<Types>       SevError events beyond the spec's
+                        `sev_error_allowlist` (or any at all when the
+                        spec names none); uncaptured overflow past the
+                        sink's retention counts as its own pseudo-type
+      check:<keys>      workload check phases (or restart-phase
+                        state-carry) reported False
+      pass              the seed is green under the sweep's gate
+    """
+    allow = set(spec.get("sev_error_allowlist", ()))
+    events = res.get("sev_error_events") or []
+    offending = [e for e in events if e.get("Type") not in allow]
+    uncaptured = (res.get("sev_errors") or 0) - len(events)
+    if uncaptured > 0 and (allow or not events):
+        offending.append({"Type": "<uncaptured>", "Count": uncaptured})
+
+    failed_checks = sorted(
+        k for k, v in res.items()
+        if isinstance(v, dict) and v.get("ok") is False
+    )
+    for i, phase in enumerate(res.get("phases", [])):
+        failed_checks.extend(
+            f"phase{i}.{k}" for k, v in sorted(phase.items())
+            if isinstance(v, dict) and v.get("ok") is False
+        )
+        if phase.get("state_carried") is False:
+            failed_checks.append(f"phase{i}.state_carried")
+
+    sev_types = sorted({e.get("Type", "?") for e in offending})
+    if res.get("error"):
+        cls = "crash:" + str(res["error"]).split(":", 1)[0]
+    elif sev_types:
+        cls = "sev:" + ",".join(sev_types)
+    elif failed_checks or not res.get("ok"):
+        cls = "check:" + ",".join(failed_checks or ["?"])
+    else:
+        cls = "pass"
+    return {
+        "class": cls,
+        "ok": cls == "pass",
+        "failed_checks": failed_checks,
+        "offending_sev_types": sev_types,
+        "error": res.get("error"),
+    }
 
 
 def run_spec(spec: dict) -> dict[str, Any]:

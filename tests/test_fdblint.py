@@ -886,6 +886,57 @@ def test_wire_raw_protocol_version_good(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# pack: regression-corpus hygiene (specs/regressions/*.json)
+# ---------------------------------------------------------------------------
+
+def test_spec_regression_fields_bad(tmp_path):
+    fs = run_lint(tmp_path, {
+        # Missing origin entirely; seed is a bool (an int subclass, and
+        # a classic JSON authoring mistake the rule must still reject).
+        "specs/regressions/bad_missing.json": """
+            {"seed": true, "expect": "check:X", "spec": {"seed": 1}}
+        """,
+        "specs/regressions/bad_json.json": "{not json",
+        # A stray .py file beside the corpus must not confuse the pack.
+        "specs/regressions/readme.py": "x = 1\n",
+    })
+    specs_fs = [f for f in fs if f.rule == "spec-regression-fields"]
+    assert {f.path for f in specs_fs} == {
+        "specs/regressions/bad_missing.json",
+        "specs/regressions/bad_json.json",
+    }
+    # bad_missing: both mandatory fields flagged (bool seed + no origin).
+    assert sum(1 for f in specs_fs
+               if f.path.endswith("bad_missing.json")) == 2
+
+
+def test_spec_regression_fields_good(tmp_path):
+    fs = run_lint(tmp_path, {
+        "specs/regressions/good.json": """
+            {"seed": 7, "origin": "swarm --budget 200 seed 7, 2026-08-07",
+             "expect": "check:X", "spec": {"seed": 7}}
+        """,
+        # Specs OUTSIDE the corpus directory are not the rule's business.
+        "specs/chaos_other.json": "{not even json",
+    })
+    assert "spec-regression-fields" not in rules_of(fs)
+
+
+def test_spec_regression_fields_baseline_suppression(tmp_path):
+    fs = run_lint(tmp_path, {
+        "specs/regressions/legacy.json": '{"spec": {}}',
+    }, baseline={"specs/regressions/legacy.json::spec-regression-fields": 2})
+    specs_fs = [f for f in fs if f.rule == "spec-regression-fields"]
+    assert specs_fs and all(f.suppressed for f in specs_fs)
+
+
+def test_shipped_corpus_is_lint_clean():
+    from tools.fdblint import rules_specs
+
+    assert rules_specs.check_root(REPO_ROOT) == []
+
+
+# ---------------------------------------------------------------------------
 # the tier-1 gate: the shipped tree is clean
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,84 @@
+"""Regression-corpus replay (specs/regressions/): every checked-in
+distilled failure spec must still fail with its recorded class, twice,
+with identical fingerprints and coverage signatures.
+
+The corpus is the swarm's output contract (tools/swarm.py --corpus /
+tools/distill.py --corpus): a minimal spec whose every element is
+load-bearing for ONE failure class. Replaying it pins three things at
+tier-1 speed:
+
+  1. the failure still reproduces (the entry is a live pin, not a stale
+     artifact — when a fix lands, the replay fails with class 'pass'
+     and the entry graduates into a passing spec or is deleted with
+     the fix's PR);
+  2. the class is deterministic: two runs in this process agree on
+     class, final keyspace fingerprint AND coverage signature — the
+     simulator's replay contract over the corpus;
+  3. the metadata fdblint's `spec-regression-fields` rule requires
+     (`seed`, `origin`) is present, so every entry names its repro
+     seed and provenance.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CORPUS_DIR = os.path.join(REPO_ROOT, "specs", "regressions")
+
+
+def _entries():
+    if not os.path.isdir(CORPUS_DIR):
+        return []
+    return sorted(f for f in os.listdir(CORPUS_DIR) if f.endswith(".json"))
+
+
+def test_corpus_is_not_empty():
+    # The swarm ships with at least one distilled failure checked in;
+    # an empty corpus directory would silently skip the replay tests.
+    assert _entries(), f"no corpus entries under {CORPUS_DIR}"
+
+
+@pytest.mark.parametrize("name", _entries())
+def test_corpus_entry_metadata(name):
+    with open(os.path.join(CORPUS_DIR, name), encoding="utf-8") as f:
+        entry = json.load(f)
+    assert isinstance(entry.get("seed"), int) \
+        and not isinstance(entry.get("seed"), bool), \
+        f"{name}: mandatory 'seed' (int) missing"
+    assert isinstance(entry.get("origin"), str) \
+        and entry["origin"].strip(), \
+        f"{name}: mandatory 'origin' (provenance) missing"
+    assert isinstance(entry.get("expect"), str) and entry["expect"], \
+        f"{name}: 'expect' failure class missing"
+    assert entry["expect"] != "pass", \
+        f"{name}: a corpus entry pins a FAILURE, not a pass"
+    assert isinstance(entry.get("spec"), dict), \
+        f"{name}: 'spec' missing"
+    assert entry["spec"].get("seed") == entry["seed"], \
+        f"{name}: entry seed and spec seed disagree"
+
+
+@pytest.mark.parametrize("name", _entries())
+def test_corpus_entry_replays_deterministically(name):
+    from foundationdb_tpu.sim.config import coverage_signature
+    from tools.distill import run_and_classify
+
+    with open(os.path.join(CORPUS_DIR, name), encoding="utf-8") as f:
+        entry = json.load(f)
+    res1, cls1 = run_and_classify(entry["spec"])
+    assert cls1 == entry["expect"], (
+        f"{name}: recorded failure no longer reproduces "
+        f"(got {cls1!r}, expected {entry['expect']!r}). If a fix for "
+        f"this failure just landed, update or retire the entry in the "
+        f"same change. Origin: {entry['origin']}")
+    res2, cls2 = run_and_classify(entry["spec"])
+    assert cls2 == cls1, f"{name}: failure class is nondeterministic"
+    assert res2.get("fingerprint") == res1.get("fingerprint"), \
+        f"{name}: keyspace fingerprints diverge across replays"
+    assert coverage_signature(entry["spec"], res2) \
+        == coverage_signature(entry["spec"], res1), \
+        f"{name}: coverage signatures diverge across replays"

@@ -1,0 +1,361 @@
+"""Coverage-guided swarm stack: DrawBias steering, coverage signatures,
+corpus-driven bias construction, the failure distiller, and the swarm
+runner's batch/report plumbing (tools/swarm.py, tools/distill.py,
+sim/config.py bias hooks).
+
+Sim-heavy pieces run on deliberately tiny specs (plain sharded kind, a
+handful of transactions) so the tier stays quick; the swarm runner is
+exercised with an inline pool so no worker processes spawn here.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import random
+
+import pytest
+
+from foundationdb_tpu.sim.config import (
+    BIAS_DIMS,
+    OPTIONAL_WORKLOAD_NAMES,
+    DrawBias,
+    bias_facet,
+    coverage_facets,
+    coverage_signature,
+    generate_config,
+    knob_bucket,
+)
+from tools.distill import distill, run_and_classify
+from tools.swarm import CoverageCorpus, run_swarm
+
+# A fast deliberately-failing spec: plain sharded data plane (no
+# recovery machinery), a small Cycle, a knob override and an armed
+# SyntheticFault — the distiller must strip everything but the fault.
+FAILING_SPEC = {
+    "seed": 7,
+    "cluster": {"kind": "sharded", "n_storage": 3, "n_logs": 1,
+                "replication": "single"},
+    "knobs": {"server:COMMIT_TRANSACTION_BATCH_COUNT_MAX": 8,
+              "client:MAX_BATCH_SIZE": 16},
+    "workloads": [
+        {"name": "Cycle", "nodes": 6, "clients": 2, "txns": 4},
+        {"name": "Watches", "pairs": 2, "rounds": 1},
+        {"name": "SyntheticFault", "mode": "check_fail", "arm": True},
+    ],
+}
+
+
+# -- DrawBias steering --------------------------------------------------
+
+def test_unbiased_draws_are_deterministic():
+    for seed in (0, 11, 23):
+        assert generate_config(seed) == generate_config(seed)
+
+
+def test_biased_draws_are_deterministic_and_self_reproducing():
+    bias = DrawBias(prefer={"engine": "ssd", "topology_dcs": 2,
+                            "workload": "Increment"},
+                    strength=1.0,
+                    force_knobs={"server:MIN_SHARD_BYTES"},
+                    knob_buckets={"server:MIN_SHARD_BYTES": "hi"},
+                    allow_engine_topology=True)
+    for seed in (0, 11, 23):
+        s1 = generate_config(seed, bias)
+        s2 = generate_config(seed, bias)
+        assert s1 == s2
+        # The spec itself carries no trace of the bias: rerunning it
+        # bias-free is the repro contract the swarm prints.
+        assert "bias" not in json.dumps(s1)
+
+
+def test_bias_steers_engine_topology_joint_space():
+    bias = DrawBias(prefer={"engine": "ssd", "topology_dcs": 2,
+                            "kind": "recoverable_sharded"},
+                    strength=1.0, allow_engine_topology=True)
+    joint = 0
+    for seed in range(30):
+        spec = generate_config(seed, bias)
+        cluster = spec["cluster"]
+        if cluster.get("engine") and cluster.get("topology"):
+            joint += 1
+    # The unbiased generator NEVER draws engine x topology together;
+    # the gated bias must reach the joint space routinely.
+    assert joint >= 10
+    for seed in range(30):
+        cluster = generate_config(seed)["cluster"]
+        assert not (cluster.get("engine") and cluster.get("topology"))
+
+
+def test_bias_forces_knob_bucket():
+    key = "server:MIN_SHARD_BYTES"
+    bias = DrawBias(force_knobs={key}, knob_buckets={key: "lo"})
+    for seed in range(20):
+        spec = generate_config(seed, bias)
+        assert key in spec["knobs"]
+        assert knob_bucket(key, spec["knobs"][key]) == "lo"
+
+
+def test_bias_force_includes_workload():
+    bias = DrawBias(prefer={"workload": "Increment"}, strength=1.0)
+    for seed in range(20):
+        names = {w["name"] for w in generate_config(seed, bias)["workloads"]}
+        assert "Increment" in names
+
+
+def test_new_workloads_in_unbiased_pool():
+    names = set()
+    for seed in range(300):
+        names |= {w["name"] for w in generate_config(seed)["workloads"]}
+    assert {"Increment", "LowLatency"} <= names
+
+
+# -- coverage facets / signature ---------------------------------------
+
+def test_coverage_facets_cover_spec_dimensions():
+    spec = generate_config(3)
+    facets = coverage_facets(spec)
+    assert any(f.startswith("shape.kind=") for f in facets)
+    assert any(f.startswith("wl.") for f in facets)
+    for key in spec.get("knobs", {}):
+        assert any(f.startswith(f"knob.{key}=") for f in facets)
+
+
+def test_coverage_signature_incorporates_run_coverage():
+    spec = generate_config(3)
+    base = coverage_signature(spec)
+    with_cov = coverage_signature(spec, {
+        "coverage": {"trace_event_types": ["CommitBatch"],
+                     "recovery_states": ["fully_recovered"],
+                     "metric_names": ["proxy.txns_committed"]}})
+    assert base != with_cov
+    assert with_cov == coverage_signature(spec, {
+        "coverage": {"trace_event_types": ["CommitBatch"],
+                     "recovery_states": ["fully_recovered"],
+                     "metric_names": ["proxy.txns_committed"]}})
+
+
+def test_bias_facets_match_coverage_facet_grammar():
+    # The swarm's corpus arithmetic counts the facets coverage_facets
+    # emits; bias_facet must produce the same strings or guidance would
+    # chase buckets that can never be marked covered.
+    spec = generate_config(5)
+    facets = set(coverage_facets(spec))
+    cluster = spec["cluster"]
+    topo = cluster.get("topology")
+    assert bias_facet("kind", cluster["kind"]) in facets
+    assert bias_facet("engine", cluster.get("engine")) in facets
+    assert bias_facet("replication", cluster["replication"]) in facets
+    assert bias_facet(
+        "topology_dcs", topo["n_dcs"] if topo else None) in facets
+    assert bias_facet("regions", bool(cluster.get("regions"))) in facets
+
+
+# -- run + classification ----------------------------------------------
+
+def test_run_and_classify_the_failing_spec():
+    res, cls = run_and_classify(FAILING_SPEC)
+    assert cls == "check:SyntheticFault"
+    assert res["ok"] is False
+    # Coverage summary rides every tester result.
+    cov = res["coverage"]
+    assert cov["trace_event_types"] and cov["metric_names"]
+
+
+def test_run_and_classify_pass_and_crash():
+    passing = copy.deepcopy(FAILING_SPEC)
+    passing["workloads"] = [w for w in passing["workloads"]
+                            if w["name"] != "SyntheticFault"]
+    _, cls = run_and_classify(passing)
+    assert cls == "pass"
+    crashing = copy.deepcopy(FAILING_SPEC)
+    crashing["workloads"][-1]["mode"] = "crash"
+    _, cls = run_and_classify(crashing)
+    assert cls == "crash:RuntimeError"
+
+
+def test_replay_is_deterministic_fingerprint_and_signature():
+    res1, _ = run_and_classify(FAILING_SPEC)
+    res2, _ = run_and_classify(FAILING_SPEC)
+    assert res1.get("fingerprint") == res2.get("fingerprint")
+    assert coverage_signature(FAILING_SPEC, res1) \
+        == coverage_signature(FAILING_SPEC, res2)
+
+
+# -- distiller ----------------------------------------------------------
+
+def test_distiller_shrinks_to_minimal_failing_repro():
+    out = distill(FAILING_SPEC, budget=60)
+    minimal = out["spec"]
+    # Still fails, with the same class.
+    _, cls = run_and_classify(minimal)
+    assert cls == "check:SyntheticFault" == out["class"]
+    # Everything not load-bearing is gone: the fault stanza alone
+    # remains, and both knob overrides dropped.
+    assert [w["name"] for w in minimal["workloads"]] == ["SyntheticFault"]
+    assert "knobs" not in minimal
+    # The input spec is never mutated.
+    assert [w["name"] for w in FAILING_SPEC["workloads"]] \
+        == ["Cycle", "Watches", "SyntheticFault"]
+
+
+def test_distiller_rejects_passing_spec():
+    passing = copy.deepcopy(FAILING_SPEC)
+    passing["workloads"] = [w for w in passing["workloads"]
+                            if w["name"] != "SyntheticFault"]
+    with pytest.raises(ValueError):
+        distill(passing, budget=10)
+
+
+def test_distiller_respects_budget():
+    out = distill(FAILING_SPEC, budget=3)
+    assert out["runs"] <= 3
+    _, cls = run_and_classify(out["spec"])
+    assert cls == "check:SyntheticFault"
+
+
+def test_write_corpus_entry_fields(tmp_path):
+    from tools.distill import write_corpus_entry
+
+    path = write_corpus_entry(str(tmp_path), FAILING_SPEC,
+                              "check:SyntheticFault", "unit test")
+    with open(path, encoding="utf-8") as f:
+        entry = json.load(f)
+    assert entry["seed"] == 7
+    assert entry["origin"] == "unit test"
+    assert entry["expect"] == "check:SyntheticFault"
+    assert entry["spec"] == FAILING_SPEC
+    assert entry["signature"] == coverage_signature(FAILING_SPEC)
+
+
+# -- corpus-driven bias --------------------------------------------------
+
+def _record(spec, facets):
+    return {"seed": spec.get("seed", 0), "spec": spec, "class": "pass",
+            "ok": True, "facets": list(facets),
+            "signature": coverage_signature(spec)}
+
+
+def test_corpus_bias_is_deterministic_per_seed_and_state():
+    c1, c2 = CoverageCorpus(), CoverageCorpus()
+    spec = generate_config(1)
+    for c in (c1, c2):
+        c.add(_record(spec, coverage_facets(spec)))
+    b1, b2 = c1.bias_for(9), c2.bias_for(9)
+    assert b1.prefer == b2.prefer
+    assert b1.force_knobs == b2.force_knobs
+    assert b1.knob_buckets == b2.knob_buckets
+    assert b1.allow_engine_topology
+
+
+def test_corpus_bias_prefers_uncovered_options():
+    corpus = CoverageCorpus()
+    # Saturate every kind/engine option except the sharded kind and the
+    # ssd engine; the bias must then prefer exactly those.
+    for dim, covered in (("kind", ("recoverable_sharded",)),
+                        ("engine", (None, "memory"))):
+        for value in covered:
+            corpus.facet_counts[bias_facet(dim, value)] = 50
+    for seed in range(10):
+        bias = corpus.bias_for(seed)
+        assert bias.prefer["kind"] == "sharded"
+        assert bias.prefer["engine"] == "ssd"
+        assert bias.prefer["workload"] in OPTIONAL_WORKLOAD_NAMES
+        assert set(bias.prefer) >= set(BIAS_DIMS)
+
+
+def test_corpus_bias_tiebreak_varies_by_seed():
+    corpus = CoverageCorpus()  # empty: every option ties at zero
+    drawn = {corpus.bias_for(seed).prefer["workload"]
+             for seed in range(40)}
+    assert len(drawn) > 1  # not every seed chases the same bucket
+
+
+# -- swarm runner (inline pool: no worker processes in the quick tier) --
+
+class _InlinePool:
+    def imap(self, fn, items):
+        return [fn(i) for i in items]
+
+
+def _fake_run_one(item):
+    seed, spec, _check = item
+    # Seed 13 "fails"; facets vary per seed so buckets accumulate.
+    ok = seed != 13
+    return {"seed": seed, "spec": spec,
+            "class": "pass" if ok else "check:Synthetic",
+            "ok": ok, "facets": [f"shape.kind=k{seed % 3}",
+                                 f"knob.server:X={seed % 2}"],
+            "signature": f"sig{seed}", "sev_error_events": [],
+            "error": None}
+
+
+def test_run_swarm_report_and_failures(monkeypatch):
+    import tools.swarm as swarm_mod
+
+    monkeypatch.setattr(swarm_mod, "_run_one", _fake_run_one)
+    lines = []
+    report = run_swarm(budget=16, jobs=2, seed_base=8, guided=True,
+                       pool=_InlinePool(), log=lines.append)
+    assert report["seeds_run"] == 16
+    assert report["ok"] == 15
+    assert [f["seed"] for f in report["failures"]] == [13]
+    # The failing line prints the repro spec verbatim.
+    fail_lines = [ln for ln in lines if "FAIL" in ln]
+    assert len(fail_lines) == 1
+    assert json.loads(fail_lines[0].split("repro spec: ", 1)[1]) \
+        == report["failures"][0]["spec"]
+    assert report["distinct_signatures"] == 16
+    assert report["distinct_buckets"] == 5  # 3 kinds + 2 knob buckets
+    assert report["buckets_by_batch"][-1] == 5
+    assert report["mode"] == "guided"
+
+
+def test_swarm_auto_distills_failures_into_corpus(tmp_path):
+    from tools.swarm import _distill_failures
+
+    report = {"failures": [
+        # Nondet failures cannot anchor a replayed corpus entry.
+        {"seed": 3, "class": "nondet:fingerprint", "spec": {}},
+        {"seed": 7, "class": "check:SyntheticFault",
+         "spec": copy.deepcopy(FAILING_SPEC)},
+        # Same class again: deduped, not distilled twice.
+        {"seed": 8, "class": "check:SyntheticFault",
+         "spec": copy.deepcopy(FAILING_SPEC)},
+    ]}
+    paths = _distill_failures(report, str(tmp_path), cap=2,
+                              origin_prefix="unit swarm",
+                              log=lambda s: None)
+    assert len(paths) == 1
+    with open(paths[0], encoding="utf-8") as f:
+        entry = json.load(f)
+    assert entry["expect"] == "check:SyntheticFault"
+    assert entry["seed"] == 7
+    assert "unit swarm seed 7" in entry["origin"]
+    # The written spec is the DISTILLED minimum, not the input.
+    assert [w["name"] for w in entry["spec"]["workloads"]] \
+        == ["SyntheticFault"]
+
+
+def test_run_swarm_unguided_passes_no_bias(monkeypatch):
+    import tools.swarm as swarm_mod
+
+    seen_bias = []
+    real_generate = generate_config
+
+    def spy(seed, bias=None):
+        seen_bias.append(bias)
+        return real_generate(seed)
+
+    monkeypatch.setattr(swarm_mod, "_run_one", _fake_run_one)
+    import foundationdb_tpu.sim.config as config_mod
+
+    monkeypatch.setattr(config_mod, "generate_config", spy)
+    run_swarm(budget=4, jobs=2, guided=False, pool=_InlinePool(),
+              log=lambda s: None)
+    assert seen_bias == [None] * 4
+    seen_bias.clear()
+    run_swarm(budget=4, jobs=2, guided=True, pool=_InlinePool(),
+              log=lambda s: None)
+    assert all(b is not None for b in seen_bias)
+    assert all(b.allow_engine_topology for b in seen_bias)

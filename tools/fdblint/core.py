@@ -55,6 +55,7 @@ RULES: dict[str, str] = {
     "wire-raw-protocol-version": "raw u64(PROTOCOL_VERSION)-style version write outside core/serialize.py — bypasses write_protocol_version and the compatibility lattice",
     "knob-undeclared": "SERVER_KNOBS/CLIENT_KNOBS reference with no declaration in core/knobs.py",
     "knob-dead": "knob declared in core/knobs.py but referenced nowhere",
+    "spec-regression-fields": "regression-corpus entry (specs/regressions/*.json) missing the mandatory 'seed' (int) or 'origin' (provenance string) field, or not valid JSON",
     "pragma": "malformed fdblint pragma (unknown rule id or missing '-- reason')",
 }
 
@@ -260,6 +261,7 @@ def lint_paths(paths: Iterable[str], root: Optional[str] = None,
         rules_jax,
         rules_knobs,
         rules_metrics,
+        rules_specs,
         rules_trace,
         rules_wire,
     )
@@ -276,6 +278,8 @@ def lint_paths(paths: Iterable[str], root: Optional[str] = None,
     findings.extend(rules_knobs.check_project(ctxs))
     findings.extend(rules_jax.check_project(ctxs))
     findings.extend(rules_determinism.check_project(ctxs))
+    # Root-scoped (non-Python) pack: regression-corpus JSON hygiene.
+    findings.extend(rules_specs.check_root(root))
 
     by_path = {c.path: c for c in ctxs}
     if baseline is None:

@@ -1,0 +1,62 @@
+"""Regression-corpus spec hygiene (spec-regression-fields).
+
+Every entry in ``specs/regressions/`` is a distilled failure repro that
+tests/test_regression_corpus.py replays; the replay contract needs each
+entry to carry:
+
+  seed    the deterministic seed the spec runs under (int) — without it
+          the entry is not a repro, just a shape;
+  origin  provenance (non-empty string): which swarm/sweep run found the
+          failure and when, so a future reader can tell a live bug pin
+          from a stale artifact.
+
+Unlike the other packs this one scans JSON, not Python, so it hooks the
+runner as ``check_root(root)`` (whole-tree, path-based) rather than
+``check(ctx)``. Inline pragmas cannot apply (JSON has no comments);
+baseline suppression still does, keyed ``specs/regressions/X.json::
+spec-regression-fields``.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+from .core import Finding
+
+_REQUIRED = (
+    ("seed", int, "the deterministic repro seed"),
+    ("origin", str, "provenance of the distilled failure"),
+)
+
+
+def check_root(root: str) -> list[Finding]:
+    findings: list[Finding] = []
+    corpus = os.path.join(root, "specs", "regressions")
+    for path in sorted(glob.glob(os.path.join(corpus, "*.json"))):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                entry = json.load(f)
+        except (OSError, ValueError) as e:
+            findings.append(Finding(
+                rel, 1, "spec-regression-fields",
+                f"corpus entry is not valid JSON: {e}"))
+            continue
+        if not isinstance(entry, dict):
+            findings.append(Finding(
+                rel, 1, "spec-regression-fields",
+                "corpus entry must be a JSON object"))
+            continue
+        for key, typ, why in _REQUIRED:
+            value = entry.get(key)
+            # bool is an int subclass; a true/false seed is a mistake.
+            if (not isinstance(value, typ)
+                    or isinstance(value, bool)
+                    or (typ is str and not value.strip())):
+                findings.append(Finding(
+                    rel, 1, "spec-regression-fields",
+                    f"corpus entry missing required field "
+                    f"'{key}' ({typ.__name__}: {why})"))
+    return findings

@@ -11,9 +11,13 @@ failure reproducible from its seed alone; sim/config.py's contract).
         --seeds 7,99,4242 --check-determinism
 
 --seeds takes "lo:hi" (half-open), a comma list, or a single count N
-(== 0:N). With --check-determinism every seed runs TWICE and the final
-keyspace fingerprints must match — the simulator's replay contract.
-Exit status: number of failing seeds (0 == sweep green).
+(== 0:N). With --check-determinism every seed runs TWICE and both the
+final keyspace fingerprint AND the coverage signature
+(sim/config.coverage_signature — trace/recovery/metric surface) must
+match — the simulator's replay contract.
+Exit status: number of failing seeds (0 == sweep green), capped at 125:
+a raw count would wrap mod 256 in the exit byte, so 256 failing seeds
+read as green (the true count always prints).
 
 --preset regions sweeps the two-DC region config (specs/
 chaos_regions.json: DC kills + machine attrition over remote log
@@ -191,7 +195,10 @@ def main() -> int:
         print("note: run under PYTHONHASHSEED=0 for cross-process "
               "reproducibility", file=sys.stderr)
 
-    from foundationdb_tpu.sim.config import generate_config
+    from foundationdb_tpu.sim.config import (
+        coverage_signature,
+        generate_config,
+    )
     from foundationdb_tpu.workloads.tester import run_spec
 
     base = None
@@ -236,9 +243,17 @@ def main() -> int:
             detail = ""
             if ok and args.check_determinism:
                 res2 = run_spec(spec)
-                ok = res2.get("fingerprint") == res.get("fingerprint")
-                if not ok:
+                if res2.get("fingerprint") != res.get("fingerprint"):
+                    ok = False
                     detail = " (NON-DETERMINISTIC: fingerprints differ)"
+                elif (coverage_signature(spec, res2)
+                      != coverage_signature(spec, res)):
+                    # Same keyspace, different trace/recovery/metric
+                    # surface: the rerun took a different path — a
+                    # determinism bug the fingerprint alone cannot see.
+                    ok = False
+                    detail = (" (NON-DETERMINISTIC: coverage "
+                              "signatures differ)")
         except BaseException as e:  # noqa: BLE001 — a crashed seed is a
             # failed seed; the sweep must keep going and report it
             res = {"error": f"{type(e).__name__}: {e}"}
@@ -268,7 +283,13 @@ def main() -> int:
               "print(run_spec(json.load(open(sys.argv[1]))))\" <spec.json>")
     else:
         print("\nsweep green")
-    return len(failures)
+    # Exit-byte discipline: the raw count wraps mod 256 (256 failures
+    # would exit 0 == green); cap at 125 to stay below the shell's
+    # 126/127/128+n conventions. The true count printed above.
+    if len(failures) > 125:
+        print(f"exit status capped at 125 "
+              f"(true failure count {len(failures)})")
+    return min(len(failures), 125)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,377 @@
+#!/usr/bin/env python3
+"""Coverage-guided simulation swarm: parallel randomized-config sweeps at
+O(1000)-seed scale (ref: the reference's nightly correctness fleet —
+thousands of seeds through SimulatedCluster.actor.cpp:696, each failure
+reproducible from its seed; coverage GUIDANCE is this repo's step beyond
+that blind fleet, in the spirit of coverage-guided fuzzing).
+
+    python tools/swarm.py --budget 200 --jobs 4
+    python tools/swarm.py --budget 200 --jobs 4 --unguided
+    python tools/swarm.py --budget 200 --jobs 4 --compare-unguided \
+        --report swarm_report.json
+    python tools/swarm.py --budget 100 --check-determinism
+    python tools/swarm.py --budget 500 --corpus specs/regressions
+
+Every seed's spec is fully materialized BEFORE dispatch
+(sim/config.generate_config, optionally steered by a DrawBias built
+from the corpus of coverage facets seen so far) and printed on failure:
+the printed spec alone reproduces the failure, bias-free. Each run's
+coverage signature — cluster-shape draw x knob buckets x workload mix x
+trace event types x recovery states x metric-snapshot names — feeds a
+corpus; guidance biases the next batch's draws toward the least-covered
+buckets (engine x topology joint space included, gated off in unbiased
+draws). With --corpus, failures are auto-distilled (tools/distill.py) to
+minimal repro specs and checked into the regression corpus that
+tests/test_regression_corpus.py replays.
+
+--check-determinism reruns every green seed and compares BOTH the final
+keyspace fingerprint AND the coverage signature: identical seeds must
+re-walk the identical trace/recovery/metric surface, so signature
+divergence is a determinism bug even when the final keyspace agrees.
+
+Exit status: number of failing seeds, capped at 125 so the true count
+can never wrap mod 256 to a false green (the count prints either way).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import multiprocessing as mp
+import os
+import random
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+EXIT_CAP = 125  # os.exit truncates to a byte; 125 keeps 126/127/128+n
+#                 (shell/-signal conventions) and mod-256 wraps unreachable
+
+_FORCE_KNOBS = 3  # least-covered knob buckets force-drawn per guided seed
+
+
+def _pool_init():
+    """Worker bootstrap (spawn context): repo imports + CPU-pinned JAX
+    (a worker drawing CONFLICT_SET_IMPL=tpu must not fight for a device
+    backend; the sweep's contract is the CPU-hosted simulator)."""
+    sys.path.insert(0, ROOT)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _run_one(item: tuple) -> dict:
+    """Run one fully-materialized spec; returns the seed's swarm record.
+    Deterministic per spec — any failure reproduces from spec alone."""
+    seed, spec, check_det = item
+    from foundationdb_tpu.sim.config import (
+        coverage_facets,
+        coverage_signature,
+    )
+    from foundationdb_tpu.workloads.tester import failure_summary, run_spec
+
+    try:
+        res = run_spec(spec)
+    except BaseException as e:  # noqa: BLE001 - a crashed seed is a failed
+        # seed; the swarm must keep going and report it
+        res = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+    summary = failure_summary(spec, res)
+    cls = summary["class"]
+    signature = coverage_signature(spec, res)
+    if check_det and cls == "pass":
+        try:
+            res2 = run_spec(spec)
+        except BaseException as e:  # noqa: BLE001 - same contract as above
+            res2 = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+        if res2.get("fingerprint") != res.get("fingerprint"):
+            cls = "nondet:fingerprint"
+        elif coverage_signature(spec, res2) != signature:
+            # Same seed, same keyspace, different coverage surface: the
+            # run took a different path — a determinism bug the keyspace
+            # fingerprint alone cannot see.
+            cls = "nondet:coverage-signature"
+    return {
+        "seed": seed,
+        "spec": spec,
+        "class": cls,
+        "ok": cls == "pass",
+        "facets": coverage_facets(spec, res),
+        "signature": signature,
+        "sev_error_events": (res.get("sev_error_events") or [])[:5],
+        "error": res.get("error"),
+    }
+
+
+class CoverageCorpus:
+    """Facet-count corpus of everything the swarm has seen, and the
+    bias builder that steers the next seed toward the least-covered
+    buckets of every biasable dimension."""
+
+    def __init__(self):
+        self.facet_counts: dict[str, int] = {}
+        self.signatures: set[str] = set()
+
+    def add(self, record: dict) -> None:
+        self.signatures.add(record["signature"])
+        for f in record["facets"]:
+            self.facet_counts[f] = self.facet_counts.get(f, 0) + 1
+
+    def _least_covered(self, rng: random.Random, pairs) -> object:
+        """pairs: [(value, facet)] -> a uniformly-drawn value among the
+        least-seen facets (random tie-break keeps one batch's seeds from
+        all piling onto the same preference)."""
+        counts = [(self.facet_counts.get(facet, 0), value)
+                  for value, facet in pairs]
+        m = min(c for c, _ in counts)
+        return rng.choice([value for c, value in counts if c == m])
+
+    def bias_for(self, seed: int):
+        from foundationdb_tpu.sim.config import (
+            _KNOB_CHOICES,
+            _KNOB_RANGES,
+            BIAS_DIMS,
+            OPTIONAL_WORKLOAD_NAMES,
+            DrawBias,
+            bias_facet,
+        )
+
+        # Deterministic per (seed, corpus state at batch start): the
+        # batch barrier in run_swarm updates the corpus only between
+        # batches, so a swarm rerun rebuilds the identical bias stream.
+        rng = random.Random((seed << 1) ^ 0x5EED)
+        prefer = {
+            dim: self._least_covered(
+                rng, [(o, bias_facet(dim, o)) for o in options]
+            )
+            for dim, options in BIAS_DIMS.items()
+        }
+        prefer["workload"] = self._least_covered(
+            rng, [(n, f"wl.{n}") for n in OPTIONAL_WORKLOAD_NAMES]
+        )
+        # Rank every knob bucket facet; force-draw the rarest few.
+        bucket_pairs = [
+            ((f"{reg}:{name}", b), f"knob.{reg}:{name}={b}")
+            for name, reg, _span in _KNOB_RANGES
+            for b in ("lo", "mid", "hi")
+        ] + [
+            ((f"{reg}:{name}", c), f"knob.{reg}:{name}={c}")
+            for name, reg, choices in _KNOB_CHOICES
+            for c in sorted(set(choices))
+        ]
+        force_knobs, knob_buckets = set(), {}
+        for _ in range(_FORCE_KNOBS):
+            remaining = [(v, f) for v, f in bucket_pairs
+                         if v[0] not in force_knobs]
+            key, bucket = self._least_covered(rng, remaining)
+            force_knobs.add(key)
+            knob_buckets[key] = bucket
+        return DrawBias(prefer=prefer, strength=0.7,
+                        force_knobs=force_knobs,
+                        knob_buckets=knob_buckets,
+                        allow_engine_topology=True)
+
+
+def _shape_line(spec: dict) -> str:
+    shape = spec.get("cluster", {})
+    topo = shape.get("topology")
+    return (f" kind={shape.get('kind', 'local')}"
+            f" engine={shape.get('engine', '-')}"
+            f" replication={shape.get('replication', '-')}"
+            + (f" topology={topo['n_dcs']}x{topo['machines_per_dc']}"
+               if topo else ""))
+
+
+def run_swarm(budget: int, jobs: int, seed_base: int = 0,
+              guided: bool = True, check_determinism: bool = False,
+              pool=None, log=print) -> dict:
+    """One swarm sweep; returns the report dict. `pool` may be shared
+    across sweeps (--compare-unguided) — corpus state never is."""
+    from foundationdb_tpu.sim.config import generate_config
+
+    corpus = CoverageCorpus()
+    records: list[dict] = []
+    buckets_by_batch: list[int] = []
+    batch_size = max(2 * jobs, 8)
+    seeds = list(range(seed_base, seed_base + budget))
+    own_pool = pool is None
+    if own_pool:
+        pool = _make_pool(jobs)
+    try:
+        for start in range(0, len(seeds), batch_size):
+            batch = seeds[start:start + batch_size]
+            items = []
+            for seed in batch:
+                bias = corpus.bias_for(seed) if guided else None
+                items.append((seed, generate_config(seed, bias),
+                              check_determinism))
+            for rec in pool.imap(_run_one, items):
+                corpus.add(rec)
+                records.append(rec)
+                line = (f"[seed {rec['seed']}] "
+                        f"{'ok' if rec['ok'] else 'FAIL ' + rec['class']}"
+                        f"{_shape_line(rec['spec'])}")
+                if not rec["ok"]:
+                    if rec.get("error"):
+                        line += "\n  error: " + str(rec["error"])
+                    for e in rec.get("sev_error_events", [])[:5]:
+                        line += "\n  sev-error event: " + json.dumps(
+                            e, sort_keys=True, default=str)
+                    line += "\n  repro spec: " + json.dumps(
+                        rec["spec"], sort_keys=True, default=str)
+                log(line)
+            buckets_by_batch.append(len(corpus.facet_counts))
+    finally:
+        if own_pool:
+            pool.close()
+            pool.join()
+
+    failures = [r for r in records if not r["ok"]]
+    return {
+        "mode": "guided" if guided else "unguided",
+        "budget": budget,
+        "jobs": jobs,
+        "seed_base": seed_base,
+        "check_determinism": check_determinism,
+        "seeds_run": len(records),
+        "ok": len(records) - len(failures),
+        "failures": [{"seed": r["seed"], "class": r["class"],
+                      "spec": r["spec"]} for r in failures],
+        "distinct_signatures": len(corpus.signatures),
+        "distinct_buckets": len(corpus.facet_counts),
+        "buckets_by_batch": buckets_by_batch,
+    }
+
+
+def _make_pool(jobs: int):
+    # Spawned (not forked) workers: run_spec pulls in JAX for tpu-draw
+    # seeds, and forking a process that already initialized a backend
+    # is the classic deadlock; spawn costs one import per worker once.
+    return mp.get_context("spawn").Pool(jobs, initializer=_pool_init)
+
+
+def _distill_failures(report: dict, corpus_dir: str, cap: int,
+                      origin_prefix: str, log=print) -> list[str]:
+    """Distill up to `cap` failures — one per distinct failure class
+    (nondet classes excluded: a non-reproducible failure cannot anchor a
+    replayed corpus entry) — and write them as corpus entries."""
+    from tools.distill import distill, run_and_classify, write_corpus_entry
+
+    paths: list[str] = []
+    seen_classes: set[str] = set()
+    for failure in report["failures"]:
+        cls = failure["class"]
+        if cls.startswith("nondet") or cls in seen_classes:
+            continue
+        seen_classes.add(cls)
+        if len(paths) >= cap:
+            log(f"distill cap {cap} reached; "
+                f"remaining classes left undistilled")
+            break
+        log(f"distilling seed {failure['seed']} ({cls}) ...")
+        try:
+            out = distill(failure["spec"], target_class=cls,
+                          log=lambda s: log("  " + s))
+        except ValueError as e:
+            # The failure did not reproduce in-process (e.g. an
+            # environment-sensitive crash): report, don't write.
+            log(f"  distill skipped: {e}")
+            continue
+        res, _cls = run_and_classify(out["spec"])
+        path = write_corpus_entry(
+            corpus_dir, out["spec"], cls,
+            f"{origin_prefix} seed {failure['seed']} "
+            f"({out['runs']} shrink runs)", res)
+        log(f"  corpus entry: {path}")
+        paths.append(path)
+    return paths
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--budget", type=int, default=200,
+                    help="seeds to run (default 200)")
+    ap.add_argument("--jobs", type=int, default=4,
+                    help="parallel workers (default 4)")
+    ap.add_argument("--seed-base", type=int, default=0,
+                    help="first seed (default 0)")
+    ap.add_argument("--unguided", action="store_true",
+                    help="disable coverage guidance (blind sweep, the "
+                         "reference fleet's mode)")
+    ap.add_argument("--compare-unguided", action="store_true",
+                    help="run the SAME seed range unguided first, then "
+                         "guided, and report both bucket counts")
+    ap.add_argument("--check-determinism", action="store_true",
+                    help="rerun every green seed; keyspace fingerprint "
+                         "AND coverage signature must both match")
+    ap.add_argument("--report", help="write the JSON report here")
+    ap.add_argument("--corpus",
+                    help="auto-distill failures into regression-corpus "
+                         "entries under this directory "
+                         "(e.g. specs/regressions)")
+    ap.add_argument("--distill-cap", type=int, default=3,
+                    help="max corpus entries per run (default 3)")
+    args = ap.parse_args()
+
+    if sys.flags.hash_randomization:
+        print("note: run under PYTHONHASHSEED=0 for cross-process "
+              "reproducibility", file=sys.stderr)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    pool = _make_pool(args.jobs)
+    try:
+        reports = []
+        if args.compare_unguided:
+            print(f"--- unguided sweep: {args.budget} seeds ---")
+            reports.append(run_swarm(
+                args.budget, args.jobs, args.seed_base, guided=False,
+                check_determinism=args.check_determinism, pool=pool))
+        print(f"--- {'unguided' if args.unguided else 'guided'} sweep: "
+              f"{args.budget} seeds ---")
+        report = run_swarm(
+            args.budget, args.jobs, args.seed_base,
+            guided=not args.unguided,
+            check_determinism=args.check_determinism, pool=pool)
+        reports.append(report)
+    finally:
+        pool.close()
+        pool.join()
+
+    if args.corpus and report["failures"]:
+        report["corpus_entries"] = _distill_failures(
+            report, args.corpus, args.distill_cap,
+            f"swarm --budget {args.budget} --seed-base {args.seed_base}")
+
+    print("\n=== swarm coverage report ===")
+    for r in reports:
+        print(f"{r['mode']:>9}: {r['seeds_run']} seeds, {r['ok']} ok, "
+              f"{len(r['failures'])} failing | "
+              f"{r['distinct_signatures']} distinct signatures, "
+              f"{r['distinct_buckets']} distinct coverage buckets")
+    if args.compare_unguided:
+        un, gu = reports[0], reports[1]
+        delta = gu["distinct_buckets"] - un["distinct_buckets"]
+        print(f"guidance delta: {delta:+d} coverage buckets "
+              f"({un['distinct_buckets']} -> {gu['distinct_buckets']})")
+    failures = report["failures"]
+    if failures:
+        print(f"{len(failures)} failing seed(s): "
+              f"{[f['seed'] for f in failures]}")
+        print("re-run one with: python -c \"import json,sys; "
+              "from foundationdb_tpu.workloads.tester import run_spec; "
+              "print(run_spec(json.load(open(sys.argv[1]))))\" <spec.json>")
+    else:
+        print("swarm green")
+    if args.report:
+        payload = reports[0] if len(reports) == 1 else {
+            "unguided": reports[0], "guided": reports[1]}
+        with open(args.report, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"report: {args.report}")
+    if len(failures) > EXIT_CAP:
+        print(f"exit status capped at {EXIT_CAP} "
+              f"(true failure count {len(failures)})")
+    return min(len(failures), EXIT_CAP)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
