@@ -1205,11 +1205,13 @@ class _TmpPath:
 # ISSUE 8: closed-loop open-client commit-plane bench
 # ---------------------------------------------------------------------------
 
-def _commit_plane_knobs() -> dict:
+def _commit_plane_knobs(extra: dict | None = None) -> dict:
     """Spec knobs of the bench cluster: the ISSUE's heavy-traffic commit
     plane — pipelined proxy, GRV fast path, adaptive coalescing. Every
-    role host applies these from the shared cluster file."""
-    return {
+    role host applies these from the shared cluster file. `extra` lets a
+    study leg pin additional knobs (e.g. the detector-knee sweep's
+    server:CONFLICT_SET_IMPL)."""
+    knobs = {
         "server:PROXY_PIPELINE_DEPTH": int(
             os.environ.get("BENCH_CP_DEPTH", 4)),
         "server:GRV_CACHE_STALENESS_MS": float(
@@ -1217,6 +1219,8 @@ def _commit_plane_knobs() -> dict:
         "server:COMMIT_TRANSACTION_BATCH_INTERVAL_MAX": 0.01,
         "server:COMMIT_BATCH_BYTES_TARGET": 1 << 20,
     }
+    knobs.update(extra or {})
+    return knobs
 
 
 def run_commit_plane_child(cluster_file: str) -> None:
@@ -1376,7 +1380,7 @@ def _commit_plane_metrics(cluster_file: str) -> dict:
     return out
 
 
-def measure_commit_plane(seed: int) -> dict:
+def measure_commit_plane(seed: int, extra_knobs: dict | None = None) -> dict:
     """ISSUE 8 acceptance leg: a real `server.py -r fdbd` 3-process
     cluster (log/storage/txn over localhost TCP) under a ramp of
     closed-loop open clients (Zipf 0.99 keys, GRV + blind write + commit
@@ -1405,7 +1409,7 @@ def measure_commit_plane(seed: int) -> dict:
 
         cf, procs = _launch(
             _TmpPath(tdir),
-            spec_extra={"knobs": _commit_plane_knobs(),
+            spec_extra={"knobs": _commit_plane_knobs(extra_knobs),
                         "n_storage": 4, "n_logs": 2},
         )
         legs = []
@@ -1507,7 +1511,7 @@ def measure_commit_plane(seed: int) -> dict:
             knee = cur["clients"]
             break
     return {
-        "knobs": _commit_plane_knobs(),
+        "knobs": _commit_plane_knobs(extra_knobs),
         "stage_duration_s": duration,
         "stages": legs,
         "peak_commits_per_sec": peak["commits_per_sec"],
@@ -1518,6 +1522,185 @@ def measure_commit_plane(seed: int) -> dict:
         ),
         "target_2k_met": peak["commits_per_sec"] >= 2000.0,
     }
+
+
+def measure_wire_micro(seed: int) -> dict:
+    """ISSUE 18 profiled leg (the 1-core acceptance variant): per-request
+    peek-decode + envelope cost, r09's shipped path vs r10's. The r09
+    path is still in the tree verbatim — `_encode_value_py` /
+    `_decode_value_py` in core/serialize.py ARE the functions every
+    request ran through r09, and the object-form peek reply is the
+    TLOG_PEEK_WIRE=off oracle — so both sides of the differential run in
+    this process on identical payloads. Reported per-request so it
+    composes with the ramp legs' stage breakdowns."""
+    import numpy as np
+
+    from foundationdb_tpu.cluster.commit_wire import TaggedMutationBatch
+    from foundationdb_tpu.cluster.interfaces import Mutation
+    from foundationdb_tpu.cluster.log_system import TaggedMutation
+    from foundationdb_tpu.cluster.multiprocess import ResolveBatchReply
+    from foundationdb_tpu.core import serialize as S
+    from foundationdb_tpu.kv.atomic import MutationType
+
+    rng = np.random.default_rng(seed)
+    native_env = S._env_init() is not None
+
+    # A representative peek reply: 48 versions x 6 tagged SETs, Zipf-ish
+    # short keys + ~100B values (the log->storage catch-up shape).
+    entries = []
+    v = 10_000
+    for _ in range(48):
+        v += int(rng.integers(1, 50))
+        rows = [
+            TaggedMutation(
+                (int(rng.integers(0, 8)),),
+                Mutation(MutationType.SET_VALUE,
+                         b"cp/%08d" % int(rng.integers(0, 1 << 14)),
+                         bytes(rng.integers(0, 256, size=100,
+                                            dtype=np.uint8))),
+            )
+            for _ in range(6)
+        ]
+        entries.append((v, rows))
+
+    def timeit(fn, reps):
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                fn()
+            best = min(best, (time.perf_counter() - t0) / reps)
+        return best * 1e6  # us
+
+    # r09 peek reply: the object tree through the Python envelope.
+    def py_obj_enc():
+        w = S.BinaryWriter()
+        S._encode_value_py(w, entries)
+        return w.to_bytes()
+
+    obj_blob = py_obj_enc()
+
+    def py_obj_dec():
+        return S._decode_value_py(S.BinaryReader(obj_blob))
+
+    # r10 peek reply: columnar pack + (native) envelope of one blob.
+    col_blob = TaggedMutationBatch.from_entries(entries).to_bytes()
+
+    w = S.BinaryWriter()
+    S.encode_value(w, col_blob)
+    col_env_blob = w.to_bytes()
+
+    def col_enc():
+        w = S.BinaryWriter()
+        S.encode_value(w, TaggedMutationBatch.from_entries(
+            entries).to_bytes())
+        return w.to_bytes()
+
+    def col_dec():
+        r = S.BinaryReader(col_env_blob)
+        return TaggedMutationBatch.from_bytes(
+            S.decode_value(r)).to_entries()
+
+    peek = {
+        "entries": len(entries),
+        "mutations": sum(len(r) for _, r in entries),
+        "obj_encode_us": round(timeit(py_obj_enc, 50), 1),
+        "obj_decode_us": round(timeit(py_obj_dec, 50), 1),
+        "columnar_encode_us": round(timeit(col_enc, 200), 1),
+        "columnar_decode_us": round(timeit(col_dec, 200), 1),
+        "obj_bytes": len(obj_blob),
+        "columnar_bytes": len(col_blob),
+    }
+    peek["decode_reduction_x"] = round(
+        peek["obj_decode_us"] / peek["columnar_decode_us"], 1)
+
+    # Envelope on a fixed-shape hot-path message (resolver verdicts).
+    msg = ResolveBatchReply(
+        statuses=tuple(int(x) for x in rng.integers(0, 3, size=64)),
+        state_mutations=(),
+    )
+    msg_blob = S.encode_message(msg)
+
+    def py_msg_enc():
+        w = S.BinaryWriter()
+        w.write_protocol_version()
+        S._encode_value_py(w, msg)
+        return w.to_bytes()
+
+    def py_msg_dec():
+        r = S.BinaryReader(msg_blob)
+        r.check_protocol_version()
+        return S._decode_value_py(r)
+
+    def nat_msg_enc():
+        return S.encode_message(msg)
+
+    def nat_msg_dec():
+        return S.decode_message(msg_blob)
+
+    env = {
+        "native_loaded": native_env,
+        "py_encode_us": round(timeit(py_msg_enc, 500), 2),
+        "py_decode_us": round(timeit(py_msg_dec, 500), 2),
+        "native_encode_us": round(timeit(nat_msg_enc, 2000), 2),
+        "native_decode_us": round(timeit(nat_msg_dec, 2000), 2),
+    }
+    env["roundtrip_reduction_x"] = round(
+        (env["py_encode_us"] + env["py_decode_us"])
+        / (env["native_encode_us"] + env["native_decode_us"]), 1)
+
+    # The acceptance composite: decode a peek reply + envelope-roundtrip
+    # one request, r09 cost vs r10 cost.
+    old_us = peek["obj_decode_us"] + env["py_encode_us"] + env["py_decode_us"]
+    new_us = (peek["columnar_decode_us"]
+              + env["native_encode_us"] + env["native_decode_us"])
+    return {
+        "peek": peek,
+        "envelope": env,
+        "per_request_old_us": round(old_us, 1),
+        "per_request_new_us": round(new_us, 1),
+        "per_request_reduction_x": round(old_us / new_us, 1),
+        "reduction_ge_5x": old_us >= 5 * new_us,
+    }
+
+
+def measure_detector_knee(seed: int) -> dict:
+    """ISSUE 18 detector-knee study: the same open-client ramp per
+    CONFLICT_SET_IMPL (native C skiplist / Python oracle / TPU kernel),
+    watching where the p99 knee lands — on the 1-core container the
+    detector's host cost shifts the whole plane's saturation point.
+    Stage list via BENCH_CP_KNEE_STAGES (shorter than the headline ramp:
+    three clusters are deployed back to back)."""
+    impls = [s.strip() for s in os.environ.get(
+        "BENCH_CP_IMPLS", "native,oracle,tpu").split(",") if s.strip()]
+    stages = os.environ.get("BENCH_CP_KNEE_STAGES", "32,128,256")
+    old_stages = os.environ.get("BENCH_CP_STAGES")
+    os.environ["BENCH_CP_STAGES"] = stages
+    out: dict = {"stages": stages, "impls": {}}
+    try:
+        for impl in impls:
+            log(f"[detector-knee] CONFLICT_SET_IMPL={impl}")
+            cp = measure_commit_plane(
+                seed, extra_knobs={"server:CONFLICT_SET_IMPL": impl})
+            # Keep the study compact: stage headlines, not the full
+            # metrics/series payloads the headline ramp already records.
+            out["impls"][impl] = {
+                "peak_commits_per_sec": cp["peak_commits_per_sec"],
+                "p99_knee_clients": cp["p99_knee_clients"],
+                "stages": [
+                    {k: s.get(k) for k in
+                     ("clients", "commits_per_sec", "conflicts_per_sec",
+                      "commit_p50_ms", "commit_p99_ms", "grv_p50_ms",
+                      "grv_p99_ms")}
+                    for s in cp["stages"]
+                ],
+            }
+    finally:
+        if old_stages is None:
+            os.environ.pop("BENCH_CP_STAGES", None)
+        else:
+            os.environ["BENCH_CP_STAGES"] = old_stages
+    return out
 
 
 def measure_native_cpu(batch_txns: int, n_batches: int, key_space: int,
@@ -1723,13 +1906,22 @@ def main() -> None:
     if args.commit_plane:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         cp = measure_commit_plane(args.seed)
-        _write_bench({"commit_plane": cp}, args.bench_out)
+        payload = {"commit_plane": cp,
+                   "wire_micro": measure_wire_micro(args.seed)}
+        # The detector-knee study redeploys the cluster once per
+        # CONFLICT_SET_IMPL — skippable for quick regression runs
+        # (tools/bench_check.py sets BENCH_CP_KNEE=0).
+        if os.environ.get("BENCH_CP_KNEE", "1") == "1":
+            payload["detector_knee"] = measure_detector_knee(args.seed)
+        _write_bench(payload, args.bench_out)
         print(json.dumps({
             "metric": "commit_plane_commits_per_sec",
             "value": cp["peak_commits_per_sec"],
             "unit": "commits/s",
             "vs_baseline": cp["vs_bench_r06_commits_per_sec"],
-            "detail": cp,
+            "wire_micro_reduction_x":
+                payload["wire_micro"]["per_request_reduction_x"],
+            "detail": payload,
         }))
         return
 

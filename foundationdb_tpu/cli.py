@@ -371,6 +371,13 @@ class Cli:
             rss = gauge("process.resident_bytes")
             if rss is not None:
                 cells.append(f"rss {int(rss) >> 20}MB")
+            # r18: per-connection wire I/O (transport.bytes_in/out totals;
+            # per-peer splits live under transport.peer.* for scrapes).
+            if any(n == "transport.bytes_in" for n, _ in cm):
+                cells.append(
+                    f"net in/out KB/s "
+                    f"{rate('transport.bytes_in') / 1024:7.1f}/"
+                    f"{rate('transport.bytes_out') / 1024:7.1f}")
             lines.append(f"  [{proc:<28}] " + "  ".join(cells))
         if hot_exemplar:
             lines.append(

@@ -226,6 +226,240 @@ class CommitWireBatch:
         return out
 
 
+_TMB_MAGIC = 0xFDB7_9EEB
+_TMB_VERSION = 1
+_TMB_TAGGED = 1  # flags bit 0: rows are TaggedMutation (else bare Mutation)
+_TMB_HEADER = struct.Struct("<IHHQQQ")  # magic, ver, flags, n_ent, n_rows, n_tags
+
+
+@dataclass
+class TaggedMutationBatch:
+    """The log->storage peek payload as columns: N (version, [mutation])
+    entries ride ONE buffer — per-entry version/row-count columns, per-row
+    type/param-length columns (plus tag columns when the rows are
+    TaggedMutations, the LogRouter/spill shape) over a single value blob.
+    `from_bytes` is zero-copy np.frombuffer views; `slice()` chunks at
+    entry granularity without re-encoding rows. ROADMAP notes this is the
+    exact mutation-apply format the device storage engine will consume,
+    so the layout is defined once here, beside its push-side twin
+    (`pack_tagged_mutations`). Gated by SERVER_KNOBS.TLOG_PEEK_WIRE with
+    the object path kept as the differential oracle (`to_entries` must be
+    bit-identical to the list the log would have returned)."""
+
+    n_entries: int
+    tagged: bool
+    versions: np.ndarray    # (E,)  int64
+    row_counts: np.ndarray  # (E,)  int32
+    tag_counts: np.ndarray  # (R,)  int32  (empty when not tagged)
+    tags: np.ndarray        # (NT,) int32  (empty when not tagged)
+    m_types: np.ndarray     # (R,)  uint8
+    p1_len: np.ndarray      # (R,)  int32
+    p2_len: np.ndarray      # (R,)  int32
+    blob: bytes             # p1 rows ++ p2 rows
+
+    @classmethod
+    def from_entries(cls, entries: Sequence[tuple]) -> "TaggedMutationBatch":
+        """Columnarize [(version, [Mutation|TaggedMutation])] in one
+        linear pass (server-side encoder, off the long-poll reply)."""
+        n_e = len(entries)
+        versions = np.fromiter(
+            (v for v, _ in entries), np.int64, count=n_e
+        )
+        row_counts = np.fromiter(
+            (len(ms) for _, ms in entries), np.int32, count=n_e
+        )
+        rows = [m for _, ms in entries for m in ms]
+        tagged = bool(rows) and hasattr(rows[0], "mutation")
+        if tagged:
+            tag_counts = np.fromiter(
+                (len(r.tags) for r in rows), np.int32, count=len(rows)
+            )
+            tags = np.fromiter(
+                (t for r in rows for t in r.tags), np.int32,
+                count=int(tag_counts.sum()),
+            )
+            muts = [r.mutation for r in rows]
+        else:
+            tag_counts = np.zeros(0, np.int32)
+            tags = np.zeros(0, np.int32)
+            muts = rows
+        m_types = np.fromiter(
+            (int(m.type) for m in muts), np.uint8, count=len(muts)
+        )
+        p1 = [m.param1 for m in muts]
+        p2 = [m.param2 for m in muts]
+        return cls(
+            n_entries=n_e, tagged=tagged, versions=versions,
+            row_counts=row_counts, tag_counts=tag_counts, tags=tags,
+            m_types=m_types, p1_len=_len_col(p1), p2_len=_len_col(p2),
+            blob=b"".join(p1) + b"".join(p2),
+        )
+
+    def to_bytes(self) -> bytes:
+        flags = _TMB_TAGGED if self.tagged else 0
+        n_rows = len(self.m_types)
+        parts = [
+            _TMB_HEADER.pack(_TMB_MAGIC, _TMB_VERSION, flags,
+                             self.n_entries, n_rows, len(self.tags)),
+            np.ascontiguousarray(self.versions, np.int64).tobytes(),
+            np.ascontiguousarray(self.row_counts, np.int32).tobytes(),
+        ]
+        if self.tagged:
+            parts.append(
+                np.ascontiguousarray(self.tag_counts, np.int32).tobytes()
+            )
+            parts.append(np.ascontiguousarray(self.tags, np.int32).tobytes())
+        parts += [
+            np.ascontiguousarray(self.m_types, np.uint8).tobytes(),
+            np.ascontiguousarray(self.p1_len, np.int32).tobytes(),
+            np.ascontiguousarray(self.p2_len, np.int32).tobytes(),
+            self.blob,
+        ]
+        return b"".join(parts)
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "TaggedMutationBatch":
+        """Zero-copy parse: every column is an np.frombuffer view on the
+        reply payload; no per-entry Python work."""
+        if len(data) < _TMB_HEADER.size:
+            raise ValueError("TaggedMutationBatch payload truncated")
+        magic, version, flags, n_e, n_rows, n_tags = \
+            _TMB_HEADER.unpack_from(data, 0)
+        if magic != _TMB_MAGIC or version != _TMB_VERSION:
+            raise ValueError("not a TaggedMutationBatch payload")
+        tagged = bool(flags & _TMB_TAGGED)
+        at = _TMB_HEADER.size
+
+        def take(count, dtype):
+            nonlocal at
+            arr = np.frombuffer(data, dtype=dtype, count=count, offset=at)
+            at += arr.nbytes
+            return arr
+
+        versions = take(n_e, np.int64)
+        row_counts = take(n_e, np.int32)
+        if tagged:
+            tag_counts = take(n_rows, np.int32)
+            tags = take(n_tags, np.int32)
+        else:
+            tag_counts = np.zeros(0, np.int32)
+            tags = np.zeros(0, np.int32)
+        m_types = take(n_rows, np.uint8)
+        p1_len = take(n_rows, np.int32)
+        p2_len = take(n_rows, np.int32)
+        blob_len = int(p1_len.astype(np.int64).sum()) + \
+            int(p2_len.astype(np.int64).sum())
+        if at + blob_len > len(data):
+            raise ValueError("TaggedMutationBatch payload truncated")
+        return cls(
+            n_entries=n_e, tagged=tagged, versions=versions,
+            row_counts=row_counts, tag_counts=tag_counts, tags=tags,
+            m_types=m_types, p1_len=p1_len, p2_len=p2_len,
+            blob=data[at: at + blob_len],
+        )
+
+    def slice(self, lo: int, hi: int) -> "TaggedMutationBatch":
+        """Entries [lo, hi) as a standalone batch — chunking for bounded
+        peek replies without re-encoding any row (column slices plus two
+        blob spans)."""
+        lo = max(0, min(lo, self.n_entries))
+        hi = max(lo, min(hi, self.n_entries))
+        rc64 = self.row_counts.astype(np.int64)
+        r0 = int(rc64[:lo].sum())
+        r1 = r0 + int(rc64[lo:hi].sum())
+        p1_64 = self.p1_len.astype(np.int64)
+        p2_64 = self.p2_len.astype(np.int64)
+        p1_total = int(p1_64.sum())
+        s1, e1 = int(p1_64[:r0].sum()), int(p1_64[:r1].sum())
+        s2, e2 = int(p2_64[:r0].sum()), int(p2_64[:r1].sum())
+        if self.tagged:
+            tc64 = self.tag_counts.astype(np.int64)
+            t0, t1 = int(tc64[:r0].sum()), int(tc64[:r1].sum())
+            tag_counts = self.tag_counts[r0:r1]
+            tags = self.tags[t0:t1]
+        else:
+            tag_counts = self.tag_counts
+            tags = self.tags
+        return TaggedMutationBatch(
+            n_entries=hi - lo, tagged=self.tagged,
+            versions=self.versions[lo:hi],
+            row_counts=self.row_counts[lo:hi],
+            tag_counts=tag_counts, tags=tags,
+            m_types=self.m_types[r0:r1],
+            p1_len=self.p1_len[r0:r1], p2_len=self.p2_len[r0:r1],
+            blob=self.blob[s1:e1]
+            + self.blob[p1_total + s2: p1_total + e2],
+        )
+
+    def to_entries(self) -> list[tuple[int, list]]:
+        """Decode back into [(version, [Mutation|TaggedMutation])] —
+        bit-identical to the object path (the parity tests fingerprint
+        the applied keyspace both ways)."""
+        from ..kv.atomic import MutationType
+        from .interfaces import Mutation
+
+        blob = self.blob
+        p1_at = 0
+        p2_at = int(self.p1_len.astype(np.int64).sum())
+        muts = []
+        for i in range(len(self.m_types)):
+            l1, l2 = int(self.p1_len[i]), int(self.p2_len[i])
+            muts.append(Mutation(
+                MutationType(int(self.m_types[i])),
+                blob[p1_at: p1_at + l1], blob[p2_at: p2_at + l2],
+            ))
+            p1_at += l1
+            p2_at += l2
+        if self.tagged:
+            from .log_system import TaggedMutation
+
+            t_at = 0
+            rows = []
+            for i, m in enumerate(muts):
+                tc = int(self.tag_counts[i])
+                rows.append(TaggedMutation(
+                    tuple(int(t) for t in self.tags[t_at: t_at + tc]), m
+                ))
+                t_at += tc
+        else:
+            rows = muts
+        out = []
+        r_at = 0
+        for i in range(self.n_entries):
+            rc = int(self.row_counts[i])
+            out.append((int(self.versions[i]), rows[r_at: r_at + rc]))
+            r_at += rc
+        return out
+
+
+def maybe_wire_peek(entries: list) -> list:
+    """The in-process peek gate: under SIMULATION with
+    SERVER_KNOBS.TLOG_PEEK_WIRE on, round-trip a peek result through the
+    columnar codec so every sim seed that draws the knob exercises the
+    wire format against the object-path oracle (in-process tiers never
+    serialize, so the roundtrip IS the coverage). Real-clock processes
+    skip it: the multiprocess tier ships the actual bytes exactly once,
+    at the LogHost peek reply."""
+    from ..core.knobs import SERVER_KNOBS
+    from ..core.runtime import current_loop
+
+    if not entries or not SERVER_KNOBS.TLOG_PEEK_WIRE:
+        return entries
+    if not current_loop().is_simulated():
+        return entries
+    rows = [m for _, ms in entries for m in ms]
+    tagged = bool(rows) and hasattr(rows[0], "mutation")
+    if not all(hasattr(m, "mutation") == tagged
+               and (tagged or hasattr(m, "param1")) for m in rows):
+        # Synthetic payloads (unit tests push bare tuples through
+        # MemoryTLog.commit) aren't wire-representable; production peeks
+        # only ever carry Mutation/TaggedMutation rows.
+        return entries
+    return TaggedMutationBatch.from_bytes(
+        TaggedMutationBatch.from_entries(entries).to_bytes()
+    ).to_entries()
+
+
 # Per-txn outcome codes of a batched commit reply: the client maps them
 # back onto the exceptions the direct path raises, so transaction retry
 # loops see identical errors either way.

@@ -409,6 +409,8 @@ class DurableTaggedTLog(TaggedTLog):
             await current_loop().delay(
                 0.1 * current_loop().random.random01()
             )
+        from .commit_wire import maybe_wire_peek
+
         while True:
             d = self.durable.get()
             out = self._spilled_entries(from_version)
@@ -416,10 +418,10 @@ class DurableTaggedTLog(TaggedTLog):
                 # Possibly-truncated spill read: more spilled versions may
                 # follow — appending in-memory entries here could skip the
                 # gap. The consumer re-peeks from its advanced cursor.
-                return out
+                return maybe_wire_peek(out)
             out += [e for e in self._entries if from_version < e[0] <= d]
             if out:
-                return out
+                return maybe_wire_peek(out)
             await self.durable.when_at_least(max(d, from_version) + 1)
 
     def _drop_spilled_upto(self, version: int) -> None:

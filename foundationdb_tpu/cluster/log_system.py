@@ -155,14 +155,16 @@ class TaggedTLog(MemoryTLog):
         THIS tag's mutations only. Versions carrying nothing for the tag
         still appear (empty list): the storage server's version cursor must
         advance through every version or its reads would block forever."""
+        from .commit_wire import maybe_wire_peek
+
         entries = await self.peek(from_version)
-        return [
+        return maybe_wire_peek([
             (
                 v,
                 [tm.mutation for tm in tms if tag in tm.tags],
             )
             for v, tms in entries
-        ]
+        ])
 
     def pop_tag(self, tag: int, upto_version: int) -> None:
         """(ref: tLogPop): per-tag acknowledgment; the log discards the

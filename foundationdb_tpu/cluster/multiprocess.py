@@ -564,6 +564,17 @@ class LogHost:
             if entries is _LOST:
                 t.cancel()
                 entries = []
+            if entries and SERVER_KNOBS.TLOG_PEEK_WIRE:
+                # Columnar peek reply: ONE TaggedMutationBatch buffer
+                # instead of per-object entries through the recursive
+                # encoder (the peek-side twin of TLOG_WIRE_BATCH). An
+                # empty reply stays a bare list — its falsiness is the
+                # client's long-poll re-arm signal.
+                from .commit_wire import TaggedMutationBatch
+
+                entries = TaggedMutationBatch.from_entries(
+                    entries
+                ).to_bytes()
             return (entries, self.durable_all(), log.available_from)
         if isinstance(req, TLogPopRequest):
             log.pop_tag(req.tag, req.version)
@@ -770,6 +781,15 @@ class RemoteTagView:
                 self._pref = (self._pref + 1) % len(self._ctrls)
                 continue
             self._tracker.feed(self._hosts[k], durable_all)
+            if isinstance(entries, (bytes, bytearray)):
+                # Columnar peek reply (TLOG_PEEK_WIRE on the serving log
+                # host): decode the single buffer back into the exact
+                # entry list the object path would have sent.
+                from .commit_wire import TaggedMutationBatch
+
+                entries = TaggedMutationBatch.from_bytes(
+                    bytes(entries)
+                ).to_entries()
             if entries:
                 return entries
             if available_from > from_version:

@@ -152,7 +152,9 @@ class MemoryTLog:
             d = self.durable.get()
             out = [e for e in self._entries if from_version < e[0] <= d]
             if out:
-                return out
+                from .commit_wire import maybe_wire_peek
+
+                return maybe_wire_peek(out)
             await self.durable.when_at_least(
                 max(d, from_version) + 1
             )

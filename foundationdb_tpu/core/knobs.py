@@ -142,6 +142,23 @@ class ServerKnobs(Knobs):
         # the txn->log twin of RESOLVER_WIRE_BATCH (multiprocess tier
         # only; the in-process log systems never serialize).
         init("TLOG_WIRE_BATCH", True)
+        # Log->storage peeks ship ONE columnar TaggedMutationBatch per
+        # reply (commit_wire.TaggedMutationBatch) instead of per-object
+        # (version, [Mutation]) entries — the peek-side twin of
+        # TLOG_WIRE_BATCH. In-process tiers round-trip peek results
+        # through the codec when set (sim coverage against the object-
+        # path oracle); the multiprocess tier ships the actual bytes.
+        init("TLOG_PEEK_WIRE", True)
+        # Reply framing (net/transport.py): small replies (GRVs, reads,
+        # pops) on one connection coalesce into a single kind=2 wire
+        # frame per flush window instead of paying per-reply framing +
+        # syscalls — the reply-side mirror of the client's
+        # COMMIT_WIRE_BATCH request coalescing. INTERVAL 0 disables
+        # (every reply is its own frame — the pre-framing plane);
+        # BYTES bounds the window (a filling frame flushes early), and
+        # replies larger than BYTES bypass coalescing entirely.
+        init("REPLY_FRAME_INTERVAL", 0.0005)
+        init("REPLY_FRAME_BYTES", 1 << 16)
         # Storage (ref: fdbserver/Knobs.cpp storage section)
         init("STORAGE_DURABILITY_LAG_VERSIONS", 5 * 1_000_000)
         init("STORAGE_COMMIT_INTERVAL", 0.5)

@@ -280,7 +280,7 @@ _T_LIST, _T_TUPLE, _T_DICT = 8, 9, 10
 _T_ENUM, _T_OBJ, _T_ERROR = 11, 12, 13
 
 
-def encode_value(w: BinaryWriter, v: Any) -> None:
+def _encode_value_py(w: BinaryWriter, v: Any) -> None:
     from .runtime import Promise  # local import: avoid cycle
 
     if v is None:
@@ -305,16 +305,16 @@ def encode_value(w: BinaryWriter, v: Any) -> None:
     elif isinstance(v, list):
         w.u8(_T_LIST).u32(len(v))
         for x in v:
-            encode_value(w, x)
+            _encode_value_py(w, x)
     elif isinstance(v, tuple):
         w.u8(_T_TUPLE).u32(len(v))
         for x in v:
-            encode_value(w, x)
+            _encode_value_py(w, x)
     elif isinstance(v, dict):
         w.u8(_T_DICT).u32(len(v))
         for k, x in v.items():
-            encode_value(w, k)
-            encode_value(w, x)
+            _encode_value_py(w, k)
+            _encode_value_py(w, x)
     elif isinstance(v, BaseException):
         from .errors import FdbError
 
@@ -333,7 +333,7 @@ def encode_value(w: BinaryWriter, v: Any) -> None:
         w.u8(_T_OBJ).string(name).u32(len(fields))
         for f in fields:
             w.string(f.name)
-            encode_value(w, getattr(v, f.name))
+            _encode_value_py(w, getattr(v, f.name))
     else:
         raise TypeError(f"cannot serialize {type(v).__name__}: {v!r}")
 
@@ -346,7 +346,7 @@ def register_enum(cls: type) -> type:
     return cls
 
 
-def decode_value(r: BinaryReader) -> Any:
+def _decode_value_py(r: BinaryReader) -> Any:
     tag = r.u8()
     if tag == _T_NONE:
         return None
@@ -365,11 +365,12 @@ def decode_value(r: BinaryReader) -> Any:
     if tag == _T_STR:
         return r.string()
     if tag == _T_LIST:
-        return [decode_value(r) for _ in range(r.u32())]
+        return [_decode_value_py(r) for _ in range(r.u32())]
     if tag == _T_TUPLE:
-        return tuple(decode_value(r) for _ in range(r.u32()))
+        return tuple(_decode_value_py(r) for _ in range(r.u32()))
     if tag == _T_DICT:
-        return {decode_value(r): decode_value(r) for _ in range(r.u32())}
+        return {_decode_value_py(r): _decode_value_py(r)
+                for _ in range(r.u32())}
     if tag == _T_ENUM:
         name, val = r.string(), r.i64()
         cls = _ENUMS.get(name)
@@ -387,9 +388,58 @@ def decode_value(r: BinaryReader) -> Any:
         kwargs = {}
         for _ in range(r.u32()):
             fname = r.string()
-            kwargs[fname] = decode_value(r)
+            kwargs[fname] = _decode_value_py(r)
         return cls(**kwargs)
     raise ValueError(f"bad wire tag {tag}")
+
+
+# -- native envelope fast path --
+#
+# fdbtpu_envelope.so (native/envelope.cpp, a CPython extension) walks the
+# same tagged grammar in C, bit-identical to the functions above — the
+# Python pair stays as the fallback and the differential oracle
+# (tests/test_serialize_native.py). Initialization is lazy because the
+# extension needs the live registries plus Promise/FdbError, whose
+# modules import this one.
+
+_ENV = None
+_ENV_INIT = False
+
+
+def _env_init():
+    global _ENV, _ENV_INIT
+    _ENV_INIT = True
+    try:
+        from ..native import load_envelope
+        from .errors import FdbError, error_for_code
+        from .runtime import Promise
+
+        mod = load_envelope()
+        if mod is not None:
+            mod.setup(_MESSAGES, _ENUMS, Promise, FdbError,
+                      error_for_code, IntEnum)
+        _ENV = mod
+    except Exception:
+        _ENV = None
+    return _ENV
+
+
+def encode_value(w: BinaryWriter, v: Any) -> None:
+    env = _ENV if _ENV_INIT else _env_init()
+    if env is not None:
+        w.raw(env.encode_value(v))
+    else:
+        _encode_value_py(w, v)
+
+
+def decode_value(r: BinaryReader) -> Any:
+    env = _ENV if _ENV_INIT else _env_init()
+    # The C decoder wants a contiguous bytes buffer; readers over
+    # memoryviews (rare) stay on the Python path.
+    if env is not None and type(r._buf) is bytes:
+        obj, r._pos = env.decode_value(r._buf, r._pos)
+        return obj
+    return _decode_value_py(r)
 
 
 def encode_message(v: Any) -> bytes:

@@ -2,16 +2,23 @@
 the Makefile). Today: `crc32c`, the slice-by-8 C implementation of the
 Castagnoli CRC the wire framing checksums every packet with (same value
 as the pure-Python table walk in core/serialize.py, ~100x faster — the
-Python loop was a top-5 cost on the 1-core commit plane).
+Python loop was a top-5 cost on the 1-core commit plane), and
+`load_envelope()`, the CPython-extension codec for the self-describing
+message envelope (fdbtpu_envelope.so, bit-identical to the Python
+encode_value/decode_value in core/serialize.py).
 
 Importing this module raises ImportError when the library is not
 loadable or predates the export, so core/serialize.py keeps its
-pure-Python fallback.
+pure-Python fallback. load_envelope() returns None instead of raising:
+the envelope extension links against the exact CPython ABI, so a stale
+.so after an interpreter upgrade must degrade, not crash.
 """
 
 from __future__ import annotations
 
 import ctypes
+import importlib.util
+import os
 
 from .storage_engine import _native
 
@@ -25,3 +32,32 @@ _lib.fdbtpu_crc32c.restype = ctypes.c_uint32
 
 def crc32c(data: bytes, crc: int = 0) -> int:
     return _lib.fdbtpu_crc32c(data, len(data), crc)
+
+
+_ENVELOPE_PATH = os.path.join(os.path.dirname(_native.LIB_PATH),
+                              "fdbtpu_envelope.so")
+_envelope_mod = None
+_envelope_tried = False
+
+
+def load_envelope():
+    """Import the fdbtpu_envelope CPython extension, or None.
+
+    _native.load() above already ran `make -C native` if needed, so the
+    .so either exists by now or the toolchain is absent.
+    """
+    global _envelope_mod, _envelope_tried
+    if _envelope_tried:
+        return _envelope_mod
+    _envelope_tried = True
+    if not os.path.exists(_ENVELOPE_PATH):
+        return None
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "fdbtpu_envelope", _ENVELOPE_PATH)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _envelope_mod = mod
+    except Exception:
+        _envelope_mod = None
+    return _envelope_mod

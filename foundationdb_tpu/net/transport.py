@@ -11,6 +11,13 @@ Wire behavior mirroring the reference:
 
 - framing: [u32 length][u32 crc32c][payload], checksum verified on every
   frame (scanPackets, FlowTransport.actor.cpp:463-523);
+- reply framing: small replies bound for one connection coalesce into a
+  single kind=2 multi-reply frame per flush window
+  (SERVER_KNOBS.REPLY_FRAME_INTERVAL / REPLY_FRAME_BYTES) — the
+  reply-side mirror of the client's CommitWireBatch request coalescing:
+  N GRV/read replies pay one frame + one crc + one send instead of N.
+  INTERVAL 0 restores the one-frame-per-reply plane (set it when
+  rolling a mixed-version cluster whose older binaries predate kind=2);
 - the first frame on every connection is a ConnectPacket carrying the
   protocol version + the sender's canonical listen address (:196-210);
   version-incompatible peers are disconnected;
@@ -75,6 +82,10 @@ class _Connection:
         self._sent_connect = False
         self._got_connect = False
         self._closed = False
+        # Reply-frame coalescing window (FlowTransport._send_reply).
+        self._reply_buf: list[bytes] = []
+        self._reply_bytes = 0
+        self._reply_flush_armed = False
 
     # -- writing --
     def send_frame(self, payload: bytes) -> None:
@@ -104,6 +115,7 @@ class _Connection:
                 return
             if n <= 0:
                 break
+            self.transport._count_io(self, sent=n)
             del self._wbuf[:n]
         reactor = self.transport.reactor
         if self._wbuf and not self._closed:
@@ -119,6 +131,7 @@ class _Connection:
                 if chunk == b"":
                     self.close("peer closed")
                     return
+                self.transport._count_io(self, received=len(chunk))
                 self._rbuf += chunk
                 if len(chunk) < (1 << 16):
                     break
@@ -272,6 +285,19 @@ class FlowTransport:
         # per-peer breakdown, surfaced by multiprocess_status.
         self.incompatible_connections = 0
         self.incompatible_peers: dict[str, int] = {}
+        # Traffic counters in the process metric registry (core/metrics):
+        # process totals plus a per-peer breakdown keyed by CANONICAL
+        # peer address — counters persist across reconnects (a dict, not
+        # per-_Connection state), so `cli top` and the bench scrape see
+        # cumulative bytes, and peer cardinality is bounded by cluster
+        # size, not connection churn.
+        from ..core.stats import Counter
+
+        self.bytes_in = Counter("transport.bytes_in")
+        self.bytes_out = Counter("transport.bytes_out")
+        self.replies_framed = Counter("transport.replies_framed")
+        self._peer_io: dict[str, tuple] = {}
+        self._metrics_registered = False
 
         self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -383,6 +409,56 @@ class FlowTransport:
             peer = self._peers[addr] = Peer(self, addr)
         return peer
 
+    def _ensure_metrics(self) -> bool:
+        """Register the traffic counters once a loop is current (the
+        registry is loop-scoped; the transport is constructed before the
+        role host's loop runs)."""
+        if self._metrics_registered:
+            return True
+        try:
+            from ..core.metrics import global_registry
+
+            reg = global_registry()
+        except RuntimeError:
+            return False  # no current loop yet: totals still accumulate
+        reg.register_counter("transport.bytes_in", self.bytes_in,
+                             replace=True)
+        reg.register_counter("transport.bytes_out", self.bytes_out,
+                             replace=True)
+        reg.register_counter("transport.replies_framed",
+                             self.replies_framed, replace=True)
+        self._metrics_registered = True
+        return True
+
+    def _count_io(self, conn: _Connection, sent: int = 0,
+                  received: int = 0) -> None:
+        if sent:
+            self.bytes_out.add(sent)
+        if received:
+            self.bytes_in.add(received)
+        addr = conn.peer_addr
+        if addr is None:
+            return  # pre-ConnectPacket traffic: totals only
+        pair = self._peer_io.get(addr)
+        if pair is None:
+            if not self._ensure_metrics():
+                return
+            from ..core.metrics import global_registry
+            from ..core.stats import Counter
+
+            cin = Counter("transport.peer.bytes_in")
+            cout = Counter("transport.peer.bytes_out")
+            reg = global_registry()
+            reg.register_counter("transport.peer.bytes_in", cin,
+                                 labels=(("peer", addr),), replace=True)
+            reg.register_counter("transport.peer.bytes_out", cout,
+                                 labels=(("peer", addr),), replace=True)
+            pair = self._peer_io[addr] = (cin, cout)
+        if sent:
+            pair[1].add(sent)
+        if received:
+            pair[0].add(received)
+
     def _dispatch(self, payload: bytes, conn: _Connection) -> None:
         r = BinaryReader(payload)
         kind = r.u8()
@@ -390,6 +466,15 @@ class FlowTransport:
             self._dispatch_request(r, conn)
         elif kind == 1:
             self._dispatch_reply(r)
+        elif kind == 2:
+            # Reply frame: N length-prefixed kind-1 sub-messages
+            # coalesced into one wire frame (_flush_replies).
+            for _ in range(r.u32()):
+                sub = BinaryReader(r.bytes_())
+                if sub.u8() != 1:
+                    conn.close("bad sub-message in reply frame")
+                    return
+                self._dispatch_reply(sub)
         else:
             conn.close(f"bad message kind {kind}")
 
@@ -432,13 +517,59 @@ class FlowTransport:
         # listener-less clients — the C wire client — receive replies),
         # falling back to a dialed peer connection only if it died.
         if conn is not None and not conn._closed:
-            conn.send_frame(w.to_bytes())
+            self._queue_reply(conn, w.to_bytes())
         elif addr and not addr.startswith("0.0.0.0:"):
             self._peer(addr).send(w.to_bytes())
         # else: the source never advertised a real listen address
         # (listener-less wire client) and its connection is gone — the
         # reply has nowhere to go; reliable-until-connection-loss says
         # drop it.
+
+    def _queue_reply(self, conn: _Connection, payload: bytes) -> None:
+        """Coalesce small replies per connection into one kind=2 frame
+        per flush window (the reply-side mirror of the client's commit
+        coalescer). Oversized replies and INTERVAL=0 bypass: one frame
+        per reply, the pre-framing plane."""
+        from ..core.knobs import SERVER_KNOBS
+
+        interval = SERVER_KNOBS.REPLY_FRAME_INTERVAL
+        budget = SERVER_KNOBS.REPLY_FRAME_BYTES
+        if interval <= 0 or len(payload) >= budget:
+            conn.send_frame(payload)
+            return
+        conn._reply_buf.append(payload)
+        conn._reply_bytes += len(payload)
+        if conn._reply_bytes >= budget:
+            self._flush_replies(conn)
+            return
+        if conn._reply_flush_armed:
+            return
+        conn._reply_flush_armed = True
+
+        async def flush_later():
+            await current_loop().delay(interval)
+            conn._reply_flush_armed = False
+            self._flush_replies(conn)
+
+        spawn(flush_later(), TaskPriority.DEFAULT, name="replyFrameFlush")
+
+    def _flush_replies(self, conn: _Connection) -> None:
+        buf, conn._reply_buf = conn._reply_buf, []
+        conn._reply_bytes = 0
+        if not buf or conn._closed:
+            # Connection died with replies buffered: reliable-until-
+            # connection-loss — the requester's pending promise already
+            # failed with ConnectionFailed; drop them.
+            return
+        if len(buf) == 1:
+            conn.send_frame(buf[0])
+            return
+        w = BinaryWriter()
+        w.u8(2).u32(len(buf))
+        for p in buf:
+            w.bytes_(p)
+        conn.send_frame(w.to_bytes())
+        self.replies_framed.add(len(buf))
 
     def _dispatch_reply(self, r: BinaryReader) -> None:
         reply_token, is_err = r.u64(), r.u8()

@@ -223,10 +223,65 @@ def _load_sort_native():
             ctypes.c_int32, ctypes.POINTER(ctypes.c_int32),
         ]
         lib.fdbcs_sort_order.restype = ctypes.c_int32
+        if hasattr(lib, "fdbcs_encode_sort_order"):
+            # r18: generalized fold — sorts the raw int32 key-word matrix
+            # directly, no host-side u64 pair-key build. hasattr-gated so
+            # a stale .so still serves the single-u64 path above.
+            lib.fdbcs_encode_sort_order.argtypes = [
+                ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_uint32), ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_int32),
+            ]
+            lib.fdbcs_encode_sort_order.restype = ctypes.c_int32
         _sort_native = lib
     except Exception:  # noqa: BLE001 - fall back to numpy
         _sort_native = None
     return _sort_native
+
+
+# Below this row count the numpy path wins (native call overhead + the
+# extra ascontiguousarray copy). Tests monkeypatch this to 0 to force the
+# native path on small differential inputs.
+_NATIVE_SORT_MIN = 4096
+
+
+def _encode_sort_order(words: np.ndarray, lt: np.ndarray,
+                       n: int) -> np.ndarray:
+    """Endpoint sort order by (key words first-to-last, len<<3|tag),
+    straight off the packed int32 word matrix. One native call
+    (fdbcs_encode_sort_order) replaces the sign-flip XOR + u64 pair-key
+    interleave + lexsort chain for any key width; the numpy fallback
+    builds the pair keys and routes through _sort_order as before."""
+    n_words = words.shape[1] if words.ndim == 2 else 0
+    lib = _load_sort_native()
+    if (lib is not None and hasattr(lib, "fdbcs_encode_sort_order")
+            and n > _NATIVE_SORT_MIN):
+        import ctypes
+
+        wc = np.ascontiguousarray(words, dtype=np.int32)
+        l32 = np.ascontiguousarray(lt, dtype=np.uint32)
+        out = np.empty(n, dtype=np.int32)
+        lib.fdbcs_encode_sort_order(
+            wc.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            n_words,
+            l32.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            n,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+        return out
+    raw = words.view(np.uint32) ^ np.uint32(0x80000000)
+    pair_keys = []
+    for j in range(0, n_words, 2):
+        # hi<<32 | lo without the u64 astype/shift/or chain: write the two
+        # u32 halves of a u64 buffer directly (little-endian: low word
+        # first) — half the memory passes of the arithmetic build.
+        pair = np.zeros(n, dtype="<u8")
+        pv = pair.view("<u4").reshape(n, 2)
+        pv[:, 1] = raw[:, j]
+        if j + 1 < n_words:
+            pv[:, 0] = raw[:, j + 1]
+        pair_keys.append(pair)
+    return _sort_order(pair_keys, lt, n)
 
 
 def _sort_order(pair_keys: list, lt: np.ndarray, n: int) -> np.ndarray:
@@ -627,25 +682,12 @@ def _pack_rows(
             np.full(nr, TAG_RB, np.int32),
         ]
     )
-    # Sort by (words..., len, tag); np.lexsort's primary key is the LAST.
-    # Adjacent word pairs compose into host-side uint64 keys (unsigned raw
-    # byte order == the biased-int32 order the device uses), halving the
-    # lexsort passes — int64 is fine on HOST, it is only the device that
-    # lacks it.
+    # Sort by (words..., len, tag) — encode+sort folded into one native
+    # radix call when available; the numpy fallback composes adjacent word
+    # pairs into host-side uint64 keys and lexsorts (see
+    # _encode_sort_order).
     lt = (lens << 3) | tags  # fits int32 (len <= 14 bits)
-    raw = words.view(np.uint32) ^ np.uint32(0x80000000)
-    pair_keys = []
-    for j in range(0, n_words, 2):
-        # hi<<32 | lo without the u64 astype/shift/or chain: write the two
-        # u32 halves of a u64 buffer directly (little-endian: low word
-        # first) — half the memory passes of the arithmetic build.
-        pair = np.zeros(P_act, dtype="<u8")
-        pv = pair.view("<u4").reshape(P_act, 2)
-        pv[:, 1] = raw[:, j]
-        if j + 1 < n_words:
-            pv[:, 0] = raw[:, j + 1]
-        pair_keys.append(pair)
-    order = _sort_order(pair_keys, lt, P_act)
+    order = _encode_sort_order(words, lt, P_act)
     inv = np.empty(P_act, np.int32)
     inv[order] = np.arange(P_act, dtype=np.int32)
 

@@ -116,6 +116,10 @@ _KNOB_CHOICES = [
     # both the vectorized wire pack and the legacy object path must
     # produce seed-identical runs.
     ("RESOLVER_WIRE_BATCH", "server", ("true", "false")),
+    # r18: log->storage peeks round-trip the columnar TaggedMutationBatch
+    # codec (or not) — both peek formats must produce seed-identical
+    # runs (commit_wire.maybe_wire_peek is the in-process gate).
+    ("TLOG_PEEK_WIRE", "server", ("true", "false")),
 ]
 
 _REPLICATION_FOR = {3: ["single", "double", "triple"],

@@ -555,6 +555,50 @@ int32_t fdbcs_sort_order(const uint64_t* key, const uint32_t* lt, int32_t n,
     return 0;
 }
 
+// Generalized encode+sort fold for the HOST packer: order n rows by
+// (words[0..n_words-1], lt32) where words is the row-major int32 key-word
+// matrix the packer already built — first word most significant, signed
+// values compared as `(uint32)w ^ 0x80000000` (the same flip packing.py
+// applies before building u64 pair keys). Sorting the raw words directly
+// folds the pair-key materialization into the sort: one native call
+// replaces the numpy XOR + u32-half interleave + lexsort chain. Stable,
+// bit-equal to np.lexsort((lt,) + tuple(reversed(pair_keys))). 16-bit
+// counting passes, least-significant first (2 over lt, then 2 per word
+// from last word to first), constant digits skipped.
+int32_t fdbcs_encode_sort_order(const int32_t* words, int32_t n_words,
+                                const uint32_t* lt, int32_t n,
+                                int32_t* order_out) {
+    if (n <= 0) return 0;
+    std::vector<uint32_t> a(n), b(n), cnt(1 << 16);
+    for (int32_t i = 0; i < n; i++) a[i] = (uint32_t)i;
+    uint32_t* src = a.data();
+    uint32_t* dst = b.data();
+    const int total = 2 + 2 * (n_words > 0 ? n_words : 0);
+    for (int pass = 0; pass < total; pass++) {
+        auto digit = [&](uint32_t row) -> uint32_t {
+            if (pass < 2) return (lt[row] >> (16 * pass)) & 0xffff;
+            int p = pass - 2;
+            int w = n_words - 1 - (p >> 1);
+            uint32_t v =
+                (uint32_t)words[(int64_t)row * n_words + w] ^ 0x80000000u;
+            return (v >> (16 * (p & 1))) & 0xffff;
+        };
+        memset(cnt.data(), 0, sizeof(uint32_t) << 16);
+        for (int32_t i = 0; i < n; i++) cnt[digit(src[i])]++;
+        if (cnt[digit(src[0])] == (uint32_t)n) continue;  // constant digit
+        uint32_t sum = 0;
+        for (int d = 0; d < (1 << 16); d++) {
+            uint32_t c = cnt[d];
+            cnt[d] = sum;
+            sum += c;
+        }
+        for (int32_t i = 0; i < n; i++) dst[cnt[digit(src[i])]++] = src[i];
+        std::swap(src, dst);
+    }
+    for (int32_t i = 0; i < n; i++) order_out[i] = (int32_t)src[i];
+    return 0;
+}
+
 // Resolve one batch. Reads/writes are flattened across txns IN TXN ORDER
 // (r_txn / w_txn non-decreasing); ranges of tooOld txns must have been
 // dropped by the caller (mirroring flatten_batch's admission rules), and

@@ -1,0 +1,20 @@
+"""Slow-tier wiring of the commit-plane regression guard: a fresh
+`bench.py --commit-plane` ramp must hold ≥ 90% of the BENCH_r09 peak
+(tools/bench_check.py). Deploys a real 3-process cluster — multi-minute.
+"""
+
+import pytest
+
+pytestmark = pytest.mark.slow  # multi-minute tier (see pytest.ini)
+
+from tools.bench_check import baseline_peak, run_check
+
+
+def test_bench_r09_baseline_is_readable():
+    assert baseline_peak() > 0
+
+
+@pytest.mark.slow
+def test_commit_plane_peak_holds_r09_floor():
+    verdict = run_check()
+    assert verdict["ok"], verdict

@@ -460,6 +460,93 @@ def test_commit_wire_empty_batch():
     assert back == []
 
 
+def _peek_entries(tagged=True):
+    from foundationdb_tpu.cluster.log_system import TaggedMutation
+
+    def m(t, p1, p2):
+        return Mutation(t, p1, p2)
+
+    rows1 = [m(MutationType.SET_VALUE, b"k1", b"v" * 120),
+             m(MutationType.CLEAR_RANGE, b"a", b"z"),
+             m(MutationType.ADD_VALUE, b"", b"\x00\x01")]
+    rows2 = [m(MutationType.SET_VALUE, b"k2", b"")]
+    if tagged:
+        rows1 = [TaggedMutation((0, 2), rows1[0]),
+                 TaggedMutation((), rows1[1]),
+                 TaggedMutation((1,), rows1[2])]
+        rows2 = [TaggedMutation((5,), rows2[0])]
+    return [(7, rows1), (1 << 40, rows2), (1 << 40 | 1, [])]
+
+
+@pytest.mark.parametrize("tagged", [True, False])
+def test_tagged_mutation_batch_roundtrip(tagged):
+    """ISSUE 18 peek-wire codec: tagged and bare entry lists survive the
+    columnar buffer exactly (versions, tag vectors, empty params, empty
+    rows)."""
+    from foundationdb_tpu.cluster.commit_wire import TaggedMutationBatch
+
+    entries = _peek_entries(tagged)
+    back = TaggedMutationBatch.from_bytes(
+        TaggedMutationBatch.from_entries(entries).to_bytes()
+    ).to_entries()
+    assert back == entries
+    assert TaggedMutationBatch.from_bytes(
+        TaggedMutationBatch.from_entries([]).to_bytes()
+    ).to_entries() == []
+
+
+def test_tagged_mutation_batch_slice_bounds():
+    """slice() is the chunking primitive: every [lo, hi) window decodes
+    to exactly entries[lo:hi], and out-of-range bounds clamp instead of
+    raising."""
+    from foundationdb_tpu.cluster.commit_wire import TaggedMutationBatch
+
+    entries = _peek_entries(True)
+    batch = TaggedMutationBatch.from_bytes(
+        TaggedMutationBatch.from_entries(entries).to_bytes())
+    n = len(entries)
+    for lo in range(n + 1):
+        for hi in range(lo, n + 1):
+            assert batch.slice(lo, hi).to_entries() == entries[lo:hi]
+            # a slice re-encodes as a standalone batch
+            chunk = batch.slice(lo, hi)
+            assert TaggedMutationBatch.from_bytes(
+                chunk.to_bytes()).to_entries() == entries[lo:hi]
+    assert batch.slice(-5, n + 99).to_entries() == entries
+    assert batch.slice(2, 1).to_entries() == []
+
+
+def test_tagged_mutation_batch_truncation_rejected():
+    from foundationdb_tpu.cluster.commit_wire import TaggedMutationBatch
+
+    blob = TaggedMutationBatch.from_entries(_peek_entries(True)).to_bytes()
+    with pytest.raises(ValueError):
+        TaggedMutationBatch.from_bytes(blob[:-3])
+    with pytest.raises(ValueError):
+        TaggedMutationBatch.from_bytes(b"\x00" * 8)
+
+
+def test_maybe_wire_peek_sim_roundtrip_and_gate(sim, knob):
+    """Under a sim loop maybe_wire_peek roundtrips through the codec when
+    TLOG_PEEK_WIRE is on (the differential coverage path) and passes
+    through untouched when off; empty lists stay bare either way (the
+    falsy long-poll re-arm contract)."""
+    from foundationdb_tpu.cluster.commit_wire import maybe_wire_peek
+
+    entries = _peek_entries(True)
+
+    async def body():
+        knob("TLOG_PEEK_WIRE", True)
+        out = maybe_wire_peek(entries)
+        assert out == entries
+        assert out is not entries  # went through the codec
+        assert maybe_wire_peek([]) == []
+        knob("TLOG_PEEK_WIRE", False)
+        assert maybe_wire_peek(entries) is entries
+
+    sim.run(body())
+
+
 # ---------------------------------------------------------------------------
 # status blocks
 # ---------------------------------------------------------------------------

@@ -212,6 +212,46 @@ def test_commit_wire_vs_object_parity(cluster3):
             assert obj["rows"][i] == wir["rows"][i] == b"v%d" % i
 
 
+def test_peek_wire_vs_object_parity(tmp_path):
+    """ISSUE 18 satellite: TLOG_PEEK_WIRE is a SERVER knob (the log host
+    encodes the columnar peek reply), so parity runs one deployment per
+    format — same spec, same workload, the applied keyspace fingerprint
+    must match bit-for-bit. Storage only serves what it peeked from the
+    log, so reading every row back IS the peek-path differential."""
+    import hashlib
+
+    def run_cluster(sub: str, wire: bool) -> str:
+        base = tmp_path / sub
+        base.mkdir()
+        cf, procs = _launch(
+            base, spec_extra={"knobs": {"server:TLOG_PEEK_WIRE": wire}})
+        try:
+            async def body(db):
+                for i in range(40):
+                    await db.set(b"a%03d" % i, b"v%d" % (i * 7))
+                    await db.set(b"z%03d" % i, b"w" * (i % 23))
+                tr = db.create_transaction()
+                tr.clear_range(b"a010", b"a015")
+                await tr.commit()
+                rows = []
+                for i in range(40):
+                    rows.append((b"a%03d" % i, await db.get(b"a%03d" % i)))
+                    rows.append((b"z%03d" % i, await db.get(b"z%03d" % i)))
+                h = hashlib.sha256()
+                for k, v in rows:
+                    h.update(k)
+                    h.update(b"\x00" if v is None else b"\x01" + v)
+                return h.hexdigest()
+
+            return _client_run(cf, body, timeout_s=180)
+        finally:
+            _teardown(procs)
+
+    fp_obj = run_cluster("obj", wire=False)
+    fp_wire = run_cluster("wire", wire=True)
+    assert fp_obj == fp_wire
+
+
 def test_cycle_workload_over_processes(cluster3):
     cf, _procs = cluster3
 

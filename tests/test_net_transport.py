@@ -142,6 +142,72 @@ def test_request_reply_over_real_sockets():
         t_client.close()
 
 
+@pytest.mark.parametrize("interval", [0.002, 0.0])
+def test_reply_framing_coalesces_and_knob_disables(interval, monkeypatch):
+    """ISSUE 18 tentpole 2: with REPLY_FRAME_INTERVAL on, a burst of
+    small replies to one connection coalesces into kind=2 frames (the
+    server's replies_framed counter moves) and every reply still lands;
+    with the interval 0 (the mixed-version rollback setting) framing is
+    fully disabled. Either way the transport byte counters account the
+    connection's traffic."""
+    from foundationdb_tpu.core.knobs import SERVER_KNOBS
+
+    monkeypatch.setattr(SERVER_KNOBS, "REPLY_FRAME_INTERVAL", interval)
+    loop, t_client = real_loop_with_transport()
+    with loop_context(loop):
+        from foundationdb_tpu.net import FlowTransport
+
+        t_server = FlowTransport(loop.reactor)
+        token, _data = _kv_server(t_server)
+        remote = t_client.remote_stream(t_server.local_address, token)
+
+        async def main():
+            reqs = [GetValueRequest(key=b"hello", version=i)
+                    for i in range(64)]
+            for r in reqs:
+                remote.send(r)
+            for r in reqs:
+                assert await timeout_error(r.reply.future, 5.0) == b"world"
+
+        loop.run(main(), timeout_sim_seconds=30.0)
+        framed = t_server.replies_framed.total
+        assert t_server.bytes_in.total > 0
+        assert t_server.bytes_out.total > 0
+        assert t_client.bytes_in.total > 0
+        t_server.close()
+        t_client.close()
+    if interval > 0:
+        assert framed > 0
+    else:
+        assert framed == 0
+
+
+def test_reply_frame_bytes_budget_bypasses_oversized(monkeypatch):
+    """A reply at/over REPLY_FRAME_BYTES goes out bare immediately —
+    the budget bounds frame latency AND size."""
+    from foundationdb_tpu.core.knobs import SERVER_KNOBS
+
+    monkeypatch.setattr(SERVER_KNOBS, "REPLY_FRAME_INTERVAL", 0.002)
+    monkeypatch.setattr(SERVER_KNOBS, "REPLY_FRAME_BYTES", 64)
+    loop, t_client = real_loop_with_transport()
+    with loop_context(loop):
+        from foundationdb_tpu.net import FlowTransport
+
+        t_server = FlowTransport(loop.reactor)
+        token, data = _kv_server(t_server)
+        data[b"big"] = b"x" * 4096  # reply >> 64B budget
+        remote = t_client.remote_stream(t_server.local_address, token)
+
+        async def main():
+            req = GetValueRequest(key=b"big", version=1)
+            remote.send(req)
+            assert await timeout_error(req.reply.future, 5.0) == b"x" * 4096
+
+        loop.run(main(), timeout_sim_seconds=30.0)
+        t_server.close()
+        t_client.close()
+
+
 def test_connection_refused_fails_pending_replies():
     loop, t_client = real_loop_with_transport()
     with loop_context(loop):

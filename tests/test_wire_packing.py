@@ -198,3 +198,57 @@ def test_resolve_accepts_wire_batch():
         got = tpu.resolve(v, v - 600, wb).statuses
         assert got == expected
     assert tpu.entries() == cpu.entries()
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_encode_sort_order_native_matches_lexsort(seed, monkeypatch):
+    """ISSUE 18 satellite: the folded native encode+sort
+    (fdbcs_encode_sort_order over the raw int32 word matrix) must be a
+    stable, bit-equal replacement for the numpy pair-key + lexsort chain
+    at every key width — duplicates included, so ties exercise
+    stability."""
+    from foundationdb_tpu.resolver import packing as P
+
+    lib = P._load_sort_native()
+    if lib is None or not hasattr(lib, "fdbcs_encode_sort_order"):
+        pytest.skip("native encode sort not built")
+
+    rng = np.random.default_rng(seed)
+    for _ in range(12):
+        n = int(rng.integers(1, 4000))
+        n_words = int(rng.integers(1, 8))
+        words = rng.integers(-2**31, 2**31, size=(n, n_words),
+                             dtype=np.int64).astype(np.int32)
+        if n > 8:  # duplicate rows -> stability matters
+            words[n // 2:] = words[: n - n // 2]
+        lt = rng.integers(0, 1 << 17, size=n).astype(np.uint32)
+        monkeypatch.setattr(P, "_NATIVE_SORT_MIN", 10**9)
+        ref = np.asarray(P._encode_sort_order(words, lt, n))
+        monkeypatch.setattr(P, "_NATIVE_SORT_MIN", 0)
+        got = P._encode_sort_order(words, lt, n)
+        assert np.array_equal(ref, got), (n, n_words)
+
+
+def test_encode_sort_order_fallback_without_native(monkeypatch):
+    """With the native lib 'absent' the helper must still produce the
+    lexsort order (the pure-numpy pair-key path)."""
+    from foundationdb_tpu.resolver import packing as P
+
+    rng = np.random.default_rng(7)
+    n, n_words = 500, 3
+    words = rng.integers(-2**31, 2**31, size=(n, n_words),
+                         dtype=np.int64).astype(np.int32)
+    lt = rng.integers(0, 1 << 17, size=n).astype(np.uint32)
+    monkeypatch.setattr(P, "_sort_native", None)
+    monkeypatch.setattr(P, "_sort_native_tried", True)
+    monkeypatch.setattr(P, "_NATIVE_SORT_MIN", 0)
+    got = P._encode_sort_order(words, lt, n)
+    raw = words.view(np.uint32) ^ np.uint32(0x80000000)
+    keys = []
+    for j in range(0, n_words, 2):
+        hi = raw[:, j].astype(np.uint64) << np.uint64(32)
+        lo = (raw[:, j + 1].astype(np.uint64)
+              if j + 1 < n_words else np.zeros(n, np.uint64))
+        keys.append(hi | lo)
+    ref = np.lexsort((lt,) + tuple(reversed(keys)))
+    assert np.array_equal(np.asarray(got), ref)

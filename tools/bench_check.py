@@ -1,0 +1,85 @@
+"""Commit-plane regression guard (ISSUE 18): run a fresh
+`bench.py --commit-plane` ramp and hold its peak against the recorded
+BENCH_r09 floor. The bench artifacts are evidence; this is the tripwire
+that keeps a wire-format or batcher regression from shipping silently —
+wired as a slow-tier test (tests/test_bench_check.py) and runnable
+standalone:
+
+    python tools/bench_check.py            # exits 1 below the floor
+
+The fresh run is deliberately small (no detector-knee study, a short
+stage list around r09's knee region) so the guard costs ~1 minute, and
+the floor has 10% slack for container noise. BENCH_CHECK_FLOOR_FRAC /
+BENCH_CHECK_STAGES / BENCH_CHECK_DURATION override the envelope.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(ROOT, "BENCH_r09.json")
+
+
+def baseline_peak(path: str = BASELINE) -> float:
+    with open(path) as f:
+        return float(json.load(f)["commit_plane"]["peak_commits_per_sec"])
+
+
+def run_check(timeout_s: float = 900.0) -> dict:
+    """One fresh ramp vs the r09 floor. Returns the verdict dict; raises
+    on bench harness failure (a broken bench is a failure, not a pass)."""
+    floor_frac = float(os.environ.get("BENCH_CHECK_FLOOR_FRAC", 0.9))
+    ref = baseline_peak()
+    floor = floor_frac * ref
+    with tempfile.TemporaryDirectory(prefix="bench_check_") as td:
+        out = os.path.join(td, "fresh.json")
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"),
+            BENCH_CP_KNEE="0",
+            BENCH_CP_STAGES=os.environ.get(
+                "BENCH_CHECK_STAGES", "96,192,384"),
+            BENCH_CP_DURATION=os.environ.get("BENCH_CHECK_DURATION", "6.0"),
+        )
+        proc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "bench.py"),
+             "--commit-plane", "--bench-out", out],
+            cwd=ROOT, env=env, capture_output=True, text=True,
+            timeout=timeout_s,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"bench.py --commit-plane rc={proc.returncode}:\n"
+                f"{proc.stderr[-3000:]}"
+            )
+        with open(out) as f:
+            fresh = json.load(f)
+    peak = float(fresh["commit_plane"]["peak_commits_per_sec"])
+    wm = fresh.get("wire_micro", {})
+    return {
+        "baseline_peak_commits_per_sec": ref,
+        "floor_commits_per_sec": round(floor, 1),
+        "fresh_peak_commits_per_sec": peak,
+        "fresh_stages": [
+            {"clients": s["clients"],
+             "commits_per_sec": s["commits_per_sec"]}
+            for s in fresh["commit_plane"]["stages"]
+        ],
+        "wire_micro_reduction_x": wm.get("per_request_reduction_x"),
+        "ok": peak >= floor,
+    }
+
+
+def main() -> int:
+    verdict = run_check()
+    print(json.dumps(verdict, indent=1))
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
