@@ -1137,6 +1137,141 @@ def measure_pipeline_ycsbe_differential(total_txns: int, seed: int,
     return out
 
 
+def measure_read_sweep(batch_sizes, seed: int, n_entries: int = 100_000,
+                       n_batches: int = 8, delta_entries: int = 2048):
+    """ISSUE-19 evidence leg: the storage engine's fused batched read
+    path (storage_engine/tpu_engine.KeyValueStoreTPU) at growing batch
+    sizes — the batch-scaling twin of BENCH_r06's capacity sweep.
+
+    One engine primed with `n_entries` base entries (compacted into the
+    block-sparse layout) plus a live `delta_entries`-deep delta, then
+    per batch size P: `n_batches` fused point-read dispatches of P
+    random keys each (~1/8 misses), first batch per shape excluded (it
+    pays the XLA compile). A per-dispatch FLOOR is measured at P=1 (the
+    same probe over the same fence directory, minimal query payload):
+    on this container that floor is dominated by dispatch + sync
+    overhead the tunnel/CPU backend charges per op, not per query, so
+    the scaling claim is on the marginal cost
+
+        device_ms_per_op(P) = (min_ms(P) - floor_ms) / P
+
+    which must stay flat within +-20% across a >=16x batch range while
+    raw reads/s climbs with P. min-of-N (not p50) feeds the marginal:
+    the container's scheduler noise lands multi-ms spikes on individual
+    dispatches (p90 up to 2x p50 at small P) and the flatness claim is
+    about the KERNEL's scaling, so each point's quiet-path sample is the
+    honest estimator; p50/p90 are reported alongside so the noise is
+    auditable. A range-read sub-leg (R range windows per dispatch) and
+    an oracle spot check ride along."""
+    import numpy as np
+
+    from foundationdb_tpu.storage_engine.tpu_engine import KeyValueStoreTPU
+
+    rng = np.random.default_rng(seed)
+    eng = KeyValueStoreTPU(n_words=2)
+    keys = np.unique(rng.integers(0, 1 << 40, size=n_entries + delta_entries))
+    rng.shuffle(keys)
+    base_keys, delta_keys = keys[:n_entries], keys[n_entries:]
+    v = 1_000_000
+    for at in range(0, len(base_keys), 1 << 15):
+        chunk = base_keys[at: at + (1 << 15)]
+        eng.set_bulk([k8(int(k)) for k in chunk],
+                     [b"v%d" % k for k in chunk], v)
+        v += 1
+    eng._compact()
+    eng.set_bulk([k8(int(k)) for k in delta_keys],
+                 [b"d%d" % k for k in delta_keys], v)
+    v += 1
+
+    def draw(n):
+        hit = base_keys[rng.integers(0, len(base_keys), size=n)]
+        miss = rng.integers(1 << 41, 1 << 42, size=n)
+        take_miss = rng.random(n) < 0.125
+        return [k8(int(m if t else h))
+                for h, m, t in zip(hit, miss, take_miss)]
+
+    def run_points(p, nb):
+        lat = []
+        for b in range(nb + 1):
+            pts = [(k, v) for k in draw(p)]
+            t0 = time.perf_counter()
+            h = eng.submit_reads(pts, [])
+            pv, _ = eng.read_verdicts(h)
+            if b > 0:  # batch 0 pays the compile for this P bucket
+                lat.append(time.perf_counter() - t0)
+        return np.array(lat), pv, pts
+
+    # Floor: the per-dispatch fixed cost (probe of the SAME fence
+    # directory at the minimal query bucket).
+    floor_lat, _, _ = run_points(1, max(6, n_batches))
+    floor_ms = float(np.min(floor_lat) * 1e3)
+
+    points = []
+    for p in batch_sizes:
+        lat, pv, pts = run_points(int(p), n_batches)
+        p50 = float(np.percentile(lat, 50) * 1e3)
+        lo = float(np.min(lat) * 1e3)
+        ms_per_op = max(0.0, lo - floor_ms) / p
+        points.append({
+            "batch_reads": int(p),
+            "min_ms": round(lo, 3),
+            "p50_ms": round(p50, 3),
+            "p90_ms": round(float(np.percentile(lat, 90) * 1e3), 3),
+            "device_ms_per_op": round(ms_per_op, 5),
+            "reads_per_sec": round(p / p50 * 1e3, 1),
+        })
+        log(f"[read sweep] P={p} p50 {p50:.2f} ms  "
+            f"{points[-1]['reads_per_sec']:.0f} reads/s  "
+            f"marginal {ms_per_op * 1e3:.1f} us/op")
+
+    # Oracle spot check on the last batch: the fused answers must equal
+    # the host oracle's bit for bit (the differential the test tier pins
+    # at scale; here a tripwire on the measured configuration).
+    spot_ok = all(
+        got == eng._oracle.get(key, ver)
+        for (key, ver), got in zip(pts, pv)
+    )
+
+    # Range sub-leg: R windows per dispatch, limit 16.
+    rngs_lat = []
+    n_rq = 16
+    for b in range(4):
+        starts = base_keys[rng.integers(0, len(base_keys), size=n_rq)]
+        rqs = [(k8(int(s)), k8(int(s) + (1 << 28)), v, 16, False)
+               for s in starts]
+        t0 = time.perf_counter()
+        h = eng.submit_reads([], rqs)
+        _, rv = eng.read_verdicts(h)
+        if b > 0:
+            rngs_lat.append(time.perf_counter() - t0)
+    range_p50 = float(np.percentile(rngs_lat, 50) * 1e3)
+    log(f"[read sweep] ranges R={n_rq} p50 {range_p50:.2f} ms  "
+        f"span_fallbacks {int(eng.c_span_fallbacks.total)}")
+
+    marg = [pt["device_ms_per_op"] for pt in points]
+    spread = max(marg) / max(min(marg), 1e-9)
+    return {
+        "entries": int(len(eng)),
+        "delta_entries": int(delta_entries),
+        "blocks": eng.NB,
+        "block_slots": eng.B,
+        "n_batches": n_batches,
+        "floor_ms_per_dispatch": round(floor_ms, 3),
+        "points": points,
+        "max_over_min_ms_per_op": round(spread, 3),
+        "flat_within_20pct": spread <= 1.2 * 1.2,  # 1.2x both directions
+        "batch_size_range_x": int(max(batch_sizes) // min(batch_sizes)),
+        "oracle_spot_check_ok": bool(spot_ok),
+        "range_leg": {
+            "ranges_per_dispatch": n_rq, "limit": 16,
+            "p50_ms": round(range_p50, 3),
+            "span_fallbacks": int(eng.c_span_fallbacks.total),
+        },
+        "compactions": int(eng.c_compactions.total),
+        "delta_folds": int(eng.c_delta_folds.total),
+    }
+
+
 def measure_multiprocess_commit(n_commits: int = 200):
     """End-to-end commit p50 through the DEPLOYED pipeline: a real
     3-process cluster (log/storage/txn hosts over localhost TCP), the txn
@@ -1862,6 +1997,10 @@ def main() -> None:
     ap.add_argument("--sharded-sweep-child", action="store_true",
                     help="internal: run the sharded sweep in THIS process "
                          "(device count already pinned) and print JSON")
+    ap.add_argument("--read-sweep", action="store_true",
+                    help="run ONLY the ISSUE-19 storage-engine batched "
+                         "read sweep (fused point/range reads at growing "
+                         "batch sizes) and write it to --bench-out")
     ap.add_argument("--commit-plane", action="store_true",
                     help="run ONLY the ISSUE-8 closed-loop commit-plane "
                          "leg (real 3-process cluster, open-client ramp "
@@ -1897,6 +2036,30 @@ def main() -> None:
     )
     sharded_batch = int(os.environ.get("BENCH_SHARDED_BATCH", 512))
     sharded_nshards = int(os.environ.get("BENCH_SHARDED_NSHARDS", 4))
+
+    if args.read_sweep:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        _enable_compile_cache()
+        # Default window 1024..16384 (16x): below ~1K reads/dispatch the
+        # per-dispatch floor dominates p50 and the marginal estimate is
+        # pure noise on this container — the floor is REPORTED, the
+        # flatness claim is on the marginal region.
+        read_batches = tuple(int(x) for x in os.environ.get(
+            "BENCH_READ_BATCHES", "1024,2048,4096,8192,16384").split(","))
+        sweep = measure_read_sweep(
+            read_batches, args.seed,
+            n_entries=int(os.environ.get("BENCH_READ_ENTRIES", 100_000)),
+            n_batches=int(os.environ.get("BENCH_READ_NBATCHES", 12)),
+        )
+        _write_bench({"read_sweep": sweep}, args.bench_out)
+        print(json.dumps({
+            "metric": "storage_read_sweep_max_over_min",
+            "value": sweep["max_over_min_ms_per_op"],
+            "unit": "ratio",
+            "flat_within_20pct": sweep["flat_within_20pct"],
+            "detail": {"read_sweep": sweep},
+        }))
+        return
 
     if args.commit_plane_child:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")

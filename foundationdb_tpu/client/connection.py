@@ -42,11 +42,20 @@ class ClusterConnection:
         self.storage_endpoint = storage_endpoint
         # Client-side GRV coalescing (ref: the reference client funnels
         # concurrent getReadVersion calls through one batched request per
-        # proxy, NativeAPI readVersionBatcher): callers piggyback on the
-        # in-flight request of their priority — but only while it is
-        # UNANSWERED, so the served version is always read by the server
-        # after every joiner asked (external consistency holds).
+        # proxy, NativeAPI readVersionBatcher). A joiner piggybacks on the
+        # in-flight request of its priority, but the shared request may
+        # have been SERVED at the proxy before the joiner asked (the reply
+        # can sit in flight, or in the retry loop's backoff, for a long
+        # time under faults) — so the served version can predate a commit
+        # this client has since seen acked. `_version_floor` tracks the
+        # highest version this connection has causally observed (commit
+        # acks and returned read versions); a joiner whose shared result
+        # lands below the floor it captured at call time re-fetches fresh
+        # instead of accepting a read version that travels back across its
+        # own acked writes (external consistency, ref: NativeAPI's
+        # getReadVersion ordering vs. commit acknowledgement).
         self._grv_shared: dict = {}  # priority -> Promise
+        self._version_floor = 0
         # Client-side GRV/commit counters on the metrics plane (ref: the
         # reference's TransactionMetrics CounterCollection in NativeAPI):
         # what a client process's scrape shows of ITS half of the commit
@@ -57,6 +66,7 @@ class ClusterConnection:
 
         self.c_grvs = Counter("GRVsIssued")
         self.c_grvs_coalesced = Counter("GRVsCoalesced")
+        self.c_grvs_stale_refetch = Counter("GRVsStaleRefetch")
         self.c_commits_started = Counter("CommitsStarted")
         self.c_commits_unknown = Counter("CommitsUnknownResult")
         reg = global_registry()
@@ -64,6 +74,8 @@ class ClusterConnection:
                              replace=True)
         reg.register_counter("client.grvs_coalesced",
                              self.c_grvs_coalesced, replace=True)
+        reg.register_counter("client.grvs_stale_refetch",
+                             self.c_grvs_stale_refetch, replace=True)
         reg.register_counter("client.commits_started",
                              self.c_commits_started, replace=True)
         reg.register_counter("client.commits_unknown_result",
@@ -102,6 +114,13 @@ class ClusterConnection:
                 CLIENT_KNOBS.DEFAULT_MAX_BACKOFF,
             )
 
+    def _observe_version(self, version: int) -> None:
+        """Raise the causal floor: this connection has now seen `version`
+        (a commit ack or a returned read version), so no later read
+        version it hands out may be below it."""
+        if version > self._version_floor:
+            self._version_floor = version
+
     async def get_read_version(self, priority: int = 1,
                                debug_id=None) -> int:
         # A sampled transaction bypasses client-side coalescing: its GRV
@@ -109,7 +128,10 @@ class ClusterConnection:
         # would never reach the wire), and sample rates are low enough
         # that the extra request is noise.
         if not CLIENT_KNOBS.GRV_COALESCE or debug_id is not None:
-            return await self._grv_fetch(priority, debug_id)
+            v = await self._grv_fetch(priority, debug_id)
+            self._observe_version(v)
+            return v
+        floor = self._version_floor
         shared = self._grv_shared.get(priority)
         if shared is not None and not shared.future.is_set():
             self.c_grvs_coalesced.add(1)
@@ -130,7 +152,18 @@ class ClusterConnection:
                     p.send(v)
 
             spawn(fetch(), name="grvCoalesced")
-        return await shared.future
+        v = await shared.future
+        # The shared request may have been served before a commit this
+        # caller already saw acknowledged — accepting it would read back
+        # across the caller's own write. Re-fetch fresh: any GRV served
+        # after the floor commit's ack returns at least the floor (the
+        # acked commit is quorum-durable, so every later committed
+        # version — across recoveries too — is >= it).
+        while v < floor:
+            self.c_grvs_stale_refetch.add(1)
+            v = await self._grv_fetch(priority)
+        self._observe_version(v)
+        return v
 
     async def _grv_fetch(self, priority: int, debug_id=None) -> int:
         self.c_grvs.add(1)
@@ -178,6 +211,7 @@ class ClusterConnection:
             # client ambiguity (ref: commit_unknown_result).
             self.c_commits_unknown.add(1)
             raise CommitUnknownResult()
+        self._observe_version(result.version)
         return result
 
 
@@ -251,6 +285,7 @@ class ShardedConnection(ClusterConnection):
         if result is _LOST:
             self.c_commits_unknown.add(1)
             raise CommitUnknownResult()
+        self._observe_version(result.version)
         return result
 
     def _flush_commits(self) -> None:

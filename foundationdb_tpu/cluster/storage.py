@@ -19,7 +19,6 @@ from ..core.runtime import TaskPriority, buggify, current_loop, spawn
 from ..core.trace import TraceEvent
 from ..kv.atomic import MutationType, apply_atomic
 from ..kv.keys import KeyRange, key_after
-from ..kv.versioned_map import VersionedMap
 from .interfaces import GetRangeRequest, GetValueRequest, Mutation, WatchValueRequest
 from .tlog import MemoryTLog
 
@@ -32,7 +31,19 @@ class StorageServer:
                  tag: int | None = None, engine=None):
         self.tlog = tlog
         self.tag = tag  # this server's log tag (None = untagged/solo)
-        self.data = VersionedMap()
+        # MVCC window backend: VersionedMap (host reference) or the
+        # device-resident KeyValueStoreTPU, per
+        # SERVER_KNOBS.STORAGE_ENGINE_IMPL (storage_engine/factory.py).
+        from ..storage_engine.factory import make_mvcc_window
+
+        self.data = make_mvcc_window()
+        # Read batcher (device window only): concurrent get/get_range
+        # requests coalesce into ONE fused device dispatch through the
+        # engine's submit_reads/read_verdicts split — see _read_batch_loop.
+        self._read_batch_q: list = []
+        self._read_batch_wake = PromiseStream()
+        self.read_batches = 0
+        self.read_batch_peak = 0
         # Durable tier (ref: updateStorage :2536 writing the oldest MVCC
         # versions into the IKeyValueStore + restoreDurableState :2765 on
         # boot). `engine` is any IKeyValueStore-shaped store (memory/ssd);
@@ -107,6 +118,16 @@ class StorageServer:
                            labels=lbl, replace=True)
         reg.register_bands("storage.read_ms", self.read_bands,
                            labels=lbl, replace=True)
+        if hasattr(self.data, "register_metrics"):
+            # per-engine read-path metrics (batch width, probe/gather/d2h
+            # stage samples, compaction cadence)
+            self.data.register_metrics(reg, labels=lbl)
+        reg.register_gauge("storage.read_batches_total",
+                           lambda: self.read_batches,
+                           labels=lbl, replace=True)
+        reg.register_gauge("storage.read_batch_peak_count",
+                           lambda: self.read_batch_peak,
+                           labels=lbl, replace=True)
 
     def start(self) -> None:
         from ..core.actors import serve_requests
@@ -116,6 +137,15 @@ class StorageServer:
                   name="storage_update"),
             serve_requests(self.read_stream, self._serve_one,
                            TaskPriority.STORAGE, "storage_serve"),
+            # The batcher runs for EVERY engine impl: the engine decides
+            # HOW a batch is answered (fused device dispatch vs host
+            # oracle loop), never WHEN. Identical awaits on both paths
+            # keep the sim schedule — and so every downstream
+            # loop.random draw — invariant under STORAGE_ENGINE_IMPL,
+            # which is what makes the cross-engine chaos fingerprint
+            # differential (and seed-stable engine randomization) hold.
+            spawn(self._read_batch_loop(), TaskPriority.STORAGE,
+                  name="storage_read_batch"),
         ]
         if self.engine is not None:
             self._tasks.append(
@@ -204,14 +234,9 @@ class StorageServer:
     # -- request serving: each request answered via its reply promise so the
     #    endpoint works identically in-process and across the sim network --
     async def _serve_one(self, req):
-        if isinstance(req, GetValueRequest):
+        if isinstance(req, (GetValueRequest, GetRangeRequest)):
             t0 = current_loop().now()
-            out = await self.get_value(req)
-            self.read_bands.add(current_loop().now() - t0)
-            return out
-        if isinstance(req, GetRangeRequest):
-            t0 = current_loop().now()
-            out = await self.get_range(req)
+            out = await self._batched_read(req)
             self.read_bands.add(current_loop().now() - t0)
             return out
         if isinstance(req, WatchValueRequest):
@@ -232,8 +257,9 @@ class StorageServer:
                     await loop.delay(0.05 * loop.random.random01())
                 if self._rollback_epoch != epoch:
                     break  # rolled back under us: these entries are gone
-                for m in mutations:
-                    self._apply(m, version)
+                if not self._apply_bulk(mutations, version):
+                    for m in mutations:
+                        self._apply(m, version)
                 self.version.set(version)
                 self._trigger_watches(version)
             # Window maintenance: keep MVCC history for the read-life window
@@ -312,6 +338,27 @@ class StorageServer:
             if fr.contains(key):
                 return buffered
         return None
+
+    def _apply_bulk(self, mutations, version: int) -> bool:
+        """Columnar apply fast path: an all-SET, fully-assigned,
+        fetch-free peek entry lands in the device window through ONE
+        engine set_bulk call (the whole row set staged for the next
+        packed fold — the shape commit_wire.decode_set_columns produces
+        from a TaggedMutationBatch without building Mutation objects).
+        Returns False when any row needs the per-mutation path."""
+        if not mutations or self._fetches \
+                or not hasattr(self.data, "set_bulk"):
+            return False
+        for m in mutations:
+            if m.type != MutationType.SET_VALUE \
+                    or not self.assigned[m.param1]:
+                return False
+        self.data.set_bulk([m.param1 for m in mutations],
+                           [m.param2 for m in mutations], version)
+        for m in mutations:
+            self._log_durable_set(m.param1, m.param2, version)
+            self.metrics.on_set(m.param1, m.param2)
+        return True
 
     def _apply(self, m: Mutation, version: int) -> None:
         if m.type == MutationType.CLEAR_RANGE:
@@ -431,6 +478,137 @@ class StorageServer:
         return self.data.get_range(
             req.begin, req.end, req.version, req.limit, req.reverse
         )
+
+    # -- batched read path (every engine impl; see _read_batch_loop) --
+    async def _batched_read(self, req):
+        """Version wait + shard checks per request (identical semantics
+        to the direct path), then park on the batcher: concurrent reads
+        coalesce into one fused device dispatch."""
+        if isinstance(req, GetValueRequest):
+            if buggify("storage_slow_read"):
+                await current_loop().delay(
+                    0.05 * current_loop().random.random01())
+            await self._wait_for_version(req.version)
+            self._check_owned(req.key, key_after(req.key))
+        else:
+            if buggify("storage_slow_range"):
+                await current_loop().delay(
+                    0.05 * current_loop().random.random01())
+            await self._wait_for_version(req.version)
+            self._check_owned(req.begin, req.end)
+        self.metrics.on_read()
+        from ..core.runtime import Promise
+
+        p = Promise()
+        self._read_batch_q.append((req, p))
+        self._read_batch_wake.send(None)
+        return await p.future
+
+    async def _read_batch_loop(self):
+        """Coalesce parked reads into fused dispatches, pipelined to
+        SERVER_KNOBS.STORAGE_READ_PIPELINE_DEPTH handles in flight before
+        the oldest one's verdicts are consumed (the submit/verdicts split
+        mirrors the resolver's ResolveHandle: dispatch never blocks the
+        host; read_verdicts is the ONE sync site).
+
+        An engine without submit_reads (the memory oracle) takes the SAME
+        loop — same coalescing delay, same depth gate, same yield — and
+        is answered by host-side lookups at the consume site. Engine
+        choice must never perturb the sim schedule: batches are parked,
+        dispatched, and consumed at identical instants either way; only
+        the host/device work between those instants differs (which is
+        wall time, invisible to the simulated clock)."""
+        from collections import deque
+
+        loop = current_loop()
+        batched = hasattr(self.data, "submit_reads")
+        inflight: deque = deque()  # (handle, point promises, range promises)
+        while True:
+            if not self._read_batch_q and not inflight:
+                await self._read_batch_wake.pop()
+                continue  # re-check: the ping may be stale (queue drained)
+            if self._read_batch_q:
+                if (SERVER_KNOBS.STORAGE_READ_BATCH_INTERVAL > 0
+                        and len(self._read_batch_q)
+                        < SERVER_KNOBS.STORAGE_READ_BATCH_MAX):
+                    # the coalescing window: let concurrent readers pile on
+                    await loop.delay(SERVER_KNOBS.STORAGE_READ_BATCH_INTERVAL)
+                batch = self._read_batch_q[
+                    : int(SERVER_KNOBS.STORAGE_READ_BATCH_MAX)
+                ]
+                del self._read_batch_q[: len(batch)]
+                points, pts_p, ranges, rng_p = [], [], [], []
+                for req, p in batch:
+                    # The window can advance while a request is parked
+                    # (the update loop may apply a version jump and trim
+                    # past req.version): re-check the waitForVersion
+                    # window guard here — and again at consume — so the
+                    # VersionedMap's window assertion is never reachable
+                    # from a client request.
+                    if req.version < self.oldest_version:
+                        if not p.is_set():
+                            p.send_error(TransactionTooOld())
+                        continue
+                    if isinstance(req, GetValueRequest):
+                        points.append((req.key, req.version))
+                        pts_p.append(p)
+                    else:
+                        ranges.append((req.begin, req.end, req.version,
+                                       req.limit, req.reverse))
+                        rng_p.append(p)
+                try:
+                    handle = (self.data.submit_reads(points, ranges)
+                              if batched else None)
+                except BaseException as e:
+                    for p in pts_p + rng_p:
+                        if not p.is_set():
+                            p.send_error(e)
+                    continue
+                inflight.append((handle, points, ranges, pts_p, rng_p))
+                self.read_batches += 1
+                self.read_batch_peak = max(self.read_batch_peak, len(batch))
+            depth = max(1, int(SERVER_KNOBS.STORAGE_READ_PIPELINE_DEPTH))
+            if len(inflight) >= depth or (inflight
+                                          and not self._read_batch_q):
+                # Yield before blocking on verdicts: arrivals just
+                # unblocked must enqueue ahead of the host sync so the
+                # NEXT dispatch overlaps this readback on device.
+                await loop.yield_(TaskPriority.STORAGE)
+                handle, pts, rngs, pts_p, rng_p = inflight.popleft()
+                # The window can ALSO advance between dispatch and this
+                # consume: verdicts for now-stale versions are discarded
+                # and their readers get TransactionTooOld — identically
+                # on both the device and host-oracle paths, so the reply
+                # schedule stays engine-invariant.
+                old = self.oldest_version
+                try:
+                    if batched:
+                        pv, rv = self.data.read_verdicts(handle)
+                    else:
+                        pv = [None if v < old else self.data.get(k, v)
+                              for k, v in pts]
+                        rv = [None if v < old
+                              else self.data.get_range(b, e, v, lim, rev)
+                              for b, e, v, lim, rev in rngs]
+                except BaseException as e:
+                    for p in pts_p + rng_p:
+                        if not p.is_set():
+                            p.send_error(e)
+                    continue
+                for (_, v), p, val in zip(pts, pts_p, pv):
+                    if p.is_set():
+                        continue
+                    if v < old:
+                        p.send_error(TransactionTooOld())
+                    else:
+                        p.send(val)
+                for (_, _, v, _, _), p, rows in zip(rngs, rng_p, rv):
+                    if p.is_set():
+                        continue
+                    if v < old:
+                        p.send_error(TransactionTooOld())
+                    else:
+                        p.send(rows)
 
     async def watch_value(self, req: WatchValueRequest) -> int:
         """Resolves req.reply (and returns) the version at which the value

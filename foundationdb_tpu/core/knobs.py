@@ -162,6 +162,34 @@ class ServerKnobs(Knobs):
         # Storage (ref: fdbserver/Knobs.cpp storage section)
         init("STORAGE_DURABILITY_LAG_VERSIONS", 5 * 1_000_000)
         init("STORAGE_COMMIT_INTERVAL", 0.5)
+        # MVCC-window implementation recruited for the storage role's
+        # versioned read path (storage_engine/factory.py): "memory" (the
+        # VersionedMap oracle) or "tpu" (KeyValueStoreTPU — device-
+        # resident block-sparse index with fused batched point/range
+        # reads). Distinct from the DURABLE engine kind (memory/ssd) a
+        # spec's cluster stanza selects: this knob picks how the sliding
+        # in-memory window answers reads, not how it persists.
+        init("STORAGE_ENGINE_IMPL", "memory")
+        # TPU storage engine (storage_engine/tpu_engine.py): how many
+        # delta (memtable) entries accumulate before the engine folds
+        # them into the block-sparse base state — the device compaction
+        # cadence. Smaller = tighter device state + more compaction
+        # H2Ds; larger = bigger per-read delta probe.
+        init("STORAGE_TPU_DELTA_SLOTS", 2048,
+             sim_random_range=(16, 2048))
+        # Per-dispatch cap on gathered range-read spans (rows per range
+        # query the fused kernel materializes): a wider range falls back
+        # to the host mirror, counted in storage.read_range_fallbacks.
+        init("STORAGE_TPU_SPAN_CAP", 256, sim_random_range=(8, 256))
+        # Storage read batcher (cluster/storage.py): how long the serve
+        # loop holds the first queued read open for joiners before one
+        # fused device dispatch, the per-batch request cap, and how many
+        # dispatched batches may be in flight before the batcher must
+        # consume the oldest verdicts (the submit/verdicts split
+        # mirroring TPU_PIPELINE_DEPTH).
+        init("STORAGE_READ_BATCH_INTERVAL", 0.0005)
+        init("STORAGE_READ_BATCH_MAX", 128, sim_random_range=(2, 128))
+        init("STORAGE_READ_PIPELINE_DEPTH", 2, sim_random_range=(1, 4))
         # Ratekeeper
         init("RATEKEEPER_UPDATE_INTERVAL", 0.25)
         # Server-side role-to-role RPC deadline: a lost resolver/log hop

@@ -23,6 +23,24 @@ from bisect import bisect_left, bisect_right, insort
 from typing import Iterable, Optional
 
 
+def canonical_chain(chain, oldest):
+    """Normalize one ascending (version, value|None) chain to the
+    canonical window form shared by VersionedMap.entries() and the device
+    engine's reconstruction (storage_engine/tpu_engine.entries): keep the
+    last entry <= oldest as the base, drop older; drop a tombstone base
+    outright (absence answers every read >= oldest identically, and
+    forget_before may already have erased it — so keeping it would make
+    canonicalization depend on WHEN the window was trimmed, not just on
+    its readable content)."""
+    i = 0
+    while i + 1 < len(chain) and chain[i + 1][0] <= oldest:
+        i += 1
+    chain = chain[i:]
+    if chain and chain[0][0] <= oldest and chain[0][1] is None:
+        chain = chain[1:]
+    return chain
+
+
 class VersionedMap:
     def __init__(self):
         self._keys: list[bytes] = []          # sorted live-or-dead key index
@@ -151,6 +169,22 @@ class VersionedMap:
             del self._chains[key]
             i = bisect_left(self._keys, key)
             del self._keys[i]
+
+    def entries(self) -> list[tuple[bytes, int, Optional[bytes]]]:
+        """Canonical (key, version, value|None) rows, key- then version-
+        ordered — the differential surface the device-resident engine's
+        reconstruction must match bit-for-bit, and its compaction's
+        rebuild source."""
+        out: list[tuple[bytes, int, Optional[bytes]]] = []
+        for key in self._keys:
+            c = self._chains.get(key)
+            if not c:
+                continue
+            out.extend(
+                (key, v, val)
+                for v, val in canonical_chain(c, self.oldest_version)
+            )
+        return out
 
     def __len__(self) -> int:
         return sum(

@@ -120,6 +120,12 @@ _KNOB_CHOICES = [
     # codec (or not) — both peek formats must produce seed-identical
     # runs (commit_wire.maybe_wire_peek is the in-process gate).
     ("TLOG_PEEK_WIRE", "server", ("true", "false")),
+    # r19: storage servers answer reads from the device-resident MVCC
+    # window (tpu) or the host VersionedMap (memory). The read batcher
+    # runs identically for both, so every seed must produce the same
+    # keyspace fingerprint under either draw — the swarm holds that
+    # differential live. Weighted toward the host default.
+    ("STORAGE_ENGINE_IMPL", "server", ("memory", "memory", "tpu")),
 ]
 
 _REPLICATION_FOR = {3: ["single", "double", "triple"],
@@ -176,10 +182,11 @@ class DrawBias:
                   value lands in that third of the range) or a literal
                   categorical choice.
     allow_engine_topology
-                  opens the durable-engine x machine-topology joint
-                  space, mutually exclusive in the unbiased draw
-                  (ROADMAP scenario-diversity leftover (b)); gated here
-                  so only the swarm explores it until it graduates.
+                  historically opened the durable-engine x machine-
+                  topology joint space while it was swarm-only; the
+                  space graduated into the unbiased draw (the pinned
+                  WriteDuringRead GRV-coalescing regression it surfaced
+                  is fixed), so this flag is now a compat no-op.
     """
 
     def __init__(self, prefer: Optional[dict] = None,
@@ -322,14 +329,14 @@ def generate_config(seed: int, bias: Optional[DrawBias] = None
     # Needs at least as many machines as the replication factor or the
     # policy is unsatisfiable by construction.
     topology = None
-    # Unbiased draws keep durable engines OUT of machine-blackout
-    # scenarios (power-loss over a durable fleet is the restart specs'
-    # subject); a DrawBias with allow_engine_topology opens the joint
-    # engine x topology space for the swarm (machine kills/reboots on a
-    # durable fleet run WITHOUT power_loss, so the datadir survives).
-    topo_ok = kind == "recoverable_sharded" and (
-        engine is None
-        or (bias is not None and bias.allow_engine_topology))
+    # The durable-engine x machine-topology joint space GRADUATED into
+    # the unbiased draw once the swarm-pinned WriteDuringRead regression
+    # (a GRV-coalescing external-consistency hole the joint space
+    # surfaced) was fixed: machine kills/reboots on a durable fleet run
+    # WITHOUT power_loss, so the datadir survives. DrawBias's
+    # allow_engine_topology is kept as a no-op for swarm-corpus compat
+    # (older biases still deserialize and steer).
+    topo_ok = kind == "recoverable_sharded"
     want_topo = rng.random() < 0.5 and topo_ok
     pref_dcs = bias.prefer.get("topology_dcs", _MISS) if bias else _MISS
     forced_dcs = None

@@ -1,0 +1,58 @@
+"""MVCC-window backend selection for the storage role.
+
+Twin of resolver/factory.py: the storage server's versioned read window
+has two interchangeable, differentially-pinned implementations, and
+recruitment (cluster/storage.StorageServer) goes through ONE factory
+driven by SERVER_KNOBS.STORAGE_ENGINE_IMPL:
+
+  memory  kv/versioned_map.VersionedMap — the host reference and the
+          differential oracle; the DEFAULT.
+  tpu     tpu_engine.KeyValueStoreTPU — the device-resident block-sparse
+          window answering batched point/range reads with one fused
+          fence-probe + gather dispatch (the storage role's read batcher
+          routes through its submit_reads/read_verdicts split).
+
+This is orthogonal to the DURABLE engine kind (memory/ssd files on disk,
+cluster/sharded_cluster._make_engine): STORAGE_ENGINE_IMPL picks what
+serves reads out of the MVCC window; the durable kind picks what
+survives a reboot underneath it.
+"""
+
+from __future__ import annotations
+
+KNOWN_STORAGE_ENGINE_IMPLS = ("memory", "tpu")
+
+
+def validate_storage_engine_impl(name: str | None = None) -> str:
+    """Eager STORAGE_ENGINE_IMPL validation for startup/spec-parse sites:
+    a typo'd knob must fail at configuration time with the known-impl
+    list, not deep inside storage recruitment."""
+    if name is None:
+        from ..core.knobs import SERVER_KNOBS
+
+        name = SERVER_KNOBS.STORAGE_ENGINE_IMPL
+    low = str(name).lower()
+    if low not in KNOWN_STORAGE_ENGINE_IMPLS:
+        raise ValueError(
+            f"unknown STORAGE_ENGINE_IMPL {name!r}; known implementations: "
+            + "|".join(KNOWN_STORAGE_ENGINE_IMPLS)
+        )
+    return low
+
+
+def make_mvcc_window(impl: str | None = None, **kw):
+    """Construct the knob-selected MVCC window. `impl` overrides
+    SERVER_KNOBS.STORAGE_ENGINE_IMPL (tests, explicit recruitment); extra
+    keyword arguments pass through to the tpu backend's constructor
+    (key-width/block sizing). The tpu backend additionally reads its
+    delta/span/probe knobs (STORAGE_TPU_DELTA_SLOTS, STORAGE_TPU_SPAN_CAP,
+    TPU_PROBE_KERNEL) from SERVER_KNOBS at dispatch time, so sim knob
+    randomization reaches it with no plumbing here."""
+    name = validate_storage_engine_impl(impl)
+    if name == "tpu":
+        from .tpu_engine import KeyValueStoreTPU
+
+        return KeyValueStoreTPU(**kw)
+    from ..kv.versioned_map import VersionedMap
+
+    return VersionedMap()

@@ -1,20 +1,39 @@
-"""Slow-tier wiring of the commit-plane regression guard: a fresh
-`bench.py --commit-plane` ramp must hold ≥ 90% of the BENCH_r09 peak
-(tools/bench_check.py). Deploys a real 3-process cluster — multi-minute.
+"""Wiring of the commit-plane regression guard (tools/bench_check.py):
+the fast tier pins the baseline contract — BENCH_r10's recorded peak is
+readable and the missing-key path SKIPS instead of KeyError-ing — and
+the slow tier runs a fresh `bench.py --commit-plane` ramp that must hold
+>= 90% of the r10 peak. The slow leg deploys a real 3-process cluster —
+multi-minute.
 """
+
+import json
 
 import pytest
 
-pytestmark = pytest.mark.slow  # multi-minute tier (see pytest.ini)
-
-from tools.bench_check import baseline_peak, run_check
+from tools.bench_check import baseline_peak, baseline_value, run_check
 
 
-def test_bench_r09_baseline_is_readable():
-    assert baseline_peak() > 0
+def test_bench_r10_baseline_is_readable():
+    # The pinned floor: BENCH_r10's commit-plane peak (2869 commits/s at
+    # record time; re-read from the artifact so the pin follows it).
+    assert baseline_peak() > 2800
+
+
+def test_missing_baseline_key_is_skipped_not_keyerror(tmp_path):
+    old = tmp_path / "BENCH_old.json"
+    old.write_text(json.dumps({"capacity_sweep": {"max_over_min": 1.1}}))
+    assert baseline_value(
+        ("commit_plane", "peak_commits_per_sec"), str(old)
+    ) is None
+    # Non-dict along the path must also degrade to None, not TypeError.
+    weird = tmp_path / "BENCH_weird.json"
+    weird.write_text(json.dumps({"commit_plane": [1, 2, 3]}))
+    assert baseline_value(
+        ("commit_plane", "peak_commits_per_sec"), str(weird)
+    ) is None
 
 
 @pytest.mark.slow
-def test_commit_plane_peak_holds_r09_floor():
+def test_commit_plane_peak_holds_r10_floor():
     verdict = run_check()
     assert verdict["ok"], verdict

@@ -430,6 +430,34 @@ def test_jax_pipeline_sync_good_sites(tmp_path):
     assert rules_of(fs) == []
 
 
+def test_jax_pipeline_sync_storage_read_bad(tmp_path):
+    """The storage engine's read pipeline carries the same contract as
+    the resolver's: syncing a submit_reads handle outside the designated
+    sites is a finding."""
+    fs = run_lint(tmp_path, {SIM: """
+        import numpy as np
+
+        def batch_loop(engine, points, ranges):
+            h = engine.submit_reads(points, ranges)
+            peek = np.asarray(h._st_aux)   # sync mid-pipeline
+            return h, peek
+    """})
+    assert rules_of(fs) == ["jax-pipeline-sync"]
+    assert len([f for f in fs if not f.suppressed]) == 1
+
+
+def test_jax_pipeline_sync_storage_read_good_site(tmp_path):
+    """read_verdicts is the designated sync site for read handles."""
+    fs = run_lint(tmp_path, {SIM: """
+        import numpy as np
+
+        def read_verdicts(engine, points, ranges):
+            h = engine.submit_reads(points, ranges)
+            return np.asarray(h._st_aux)
+    """})
+    assert rules_of(fs) == []
+
+
 def test_jax_shard_map_body_reached(tmp_path):
     fs = run_lint(tmp_path, {"mod.py": """
         import jax

@@ -1,0 +1,147 @@
+"""Client GRV causal floor: external consistency vs. GRV coalescing.
+
+A coalesced getReadVersion joiner piggybacks on the in-flight shared
+request of its priority — but that request may have been SERVED at the
+proxy before the joiner asked (the reply sits in flight, or in the retry
+loop's backoff, arbitrarily long under faults). If a commit this client
+issued is acknowledged in that window, the shared version can land BELOW
+the acked commit: the joiner's read would travel back across its own
+write. The connection therefore tracks a causal version floor (commit
+acks + returned read versions) and a joiner whose shared result is below
+the floor it captured at call time re-fetches fresh.
+
+This is the fix for the swarm-pinned engine x topology regression
+(specs/regressions/check_WriteDuringRead_seed0.json, now graduated to
+specs/engine_topology_wdr.json): under machine kills + storage reboots
+on an ssd fleet, the final WriteDuringRead sweep joined a GRV issued by
+a concurrent workload, received a version ~2.5k below its last acked
+commit, and read a keyspace with the committed rows "missing".
+"""
+
+import json
+import os
+
+import pytest
+
+from foundationdb_tpu.client.connection import ClusterConnection
+from foundationdb_tpu.cluster.interfaces import CommitID
+from foundationdb_tpu.core.knobs import CLIENT_KNOBS
+from foundationdb_tpu.core.runtime import current_loop, spawn
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class _Endpoint:
+    """Captures sent requests for the test to answer by hand."""
+
+    def __init__(self):
+        self.reqs = []
+
+    def send(self, req):
+        self.reqs.append(req)
+
+
+@pytest.fixture
+def conn():
+    grv, commit = _Endpoint(), _Endpoint()
+    c = ClusterConnection(grv, commit, storage_endpoint=None)
+    return c, grv, commit
+
+
+def test_joiner_refetches_when_shared_grv_predates_acked_commit(sim, conn):
+    c, grv_ep, commit_ep = conn
+    assert CLIENT_KNOBS.GRV_COALESCE
+    results = {}
+
+    async def caller(name, *a, **kw):
+        results[name] = await c.get_read_version(*a, **kw)
+
+    async def main():
+        loop = current_loop()
+        # A starts the shared request; it reaches the wire unanswered.
+        spawn(caller("a"), name="grvA")
+        await loop.delay(0.01)
+        assert len(grv_ep.reqs) == 1
+
+        # The proxy serves version 50 — but the reply is still "in
+        # flight" from the client's point of view. Meanwhile this client
+        # commits and sees the ack at version 100.
+        async def do_commit():
+            from foundationdb_tpu.cluster.interfaces import (
+                CommitTransactionRequest,
+            )
+
+            req = CommitTransactionRequest(
+                read_snapshot=0, read_conflict_ranges=(),
+                write_conflict_ranges=(), mutations=(),
+            )
+            spawn(c.commit(req), name="commit")
+            await loop.delay(0.01)
+            commit_ep.reqs[-1].reply.send(CommitID(100))
+            await loop.delay(0.01)
+
+        await do_commit()
+        assert c._version_floor == 100
+
+        # B joins the STILL-UNANSWERED shared request after the ack.
+        spawn(caller("b"), name="grvB")
+        await loop.delay(0.01)
+        assert len(grv_ep.reqs) == 1  # B piggybacked, no new wire request
+        assert c.c_grvs_coalesced.total == 1
+
+        # Now the stale answer (served before the commit) arrives.
+        grv_ep.reqs[0].reply.send(50)
+        await loop.delay(0.01)
+        # A asked before the ack: version 50 is fine for A.
+        assert results["a"] == 50
+        # B must NOT accept 50 — it re-fetched fresh.
+        assert "b" not in results
+        assert c.c_grvs_stale_refetch.total == 1
+        assert len(grv_ep.reqs) == 2
+        grv_ep.reqs[1].reply.send(120)
+        await loop.delay(0.01)
+        assert results["b"] == 120
+        assert c._version_floor == 120
+
+    sim.run(main(), timeout_sim_seconds=60)
+
+
+def test_fresh_grv_above_floor_is_accepted_unchanged(sim, conn):
+    c, grv_ep, _ = conn
+    results = {}
+
+    async def caller(name):
+        results[name] = await c.get_read_version()
+
+    async def main():
+        loop = current_loop()
+        c._observe_version(40)
+        spawn(caller("a"), name="grvA")
+        await loop.delay(0.01)
+        grv_ep.reqs[0].reply.send(90)
+        await loop.delay(0.01)
+        assert results["a"] == 90
+        assert c.c_grvs_stale_refetch.total == 0
+        # The returned version raised the floor (monotonic reads).
+        assert c._version_floor == 90
+
+    sim.run(main(), timeout_sim_seconds=60)
+
+
+@pytest.mark.slow
+def test_graduated_engine_topology_spec_runs_green():
+    """The distilled engine x topology WriteDuringRead repro (machine
+    kills + storage reboots + swizzled clogs over an ssd fleet) replays
+    green now that coalesced GRVs respect the causal floor — twice, with
+    identical fingerprints (the corpus determinism contract it graduated
+    from)."""
+    from tools.distill import run_and_classify
+
+    with open(os.path.join(REPO_ROOT, "specs",
+                           "engine_topology_wdr.json")) as f:
+        spec = json.load(f)
+    res1, cls1 = run_and_classify(spec)
+    assert cls1 == "pass", cls1
+    res2, cls2 = run_and_classify(spec)
+    assert cls2 == "pass", cls2
+    assert res1.get("fingerprint") == res2.get("fingerprint")

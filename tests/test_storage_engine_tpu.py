@@ -1,0 +1,373 @@
+"""Device-resident MVCC window (storage_engine/tpu_engine.KeyValueStoreTPU).
+
+Tier-1 pins the engine against the bit-identical host oracle
+(kv/versioned_map.VersionedMap — the `memory` impl the factory defaults
+to): block split/merge via the compaction directory, range reads spanning
+block boundaries, MVCC version-window visibility, tombstone suppression,
+entries() canonicalization independent of forget_before timing, pipelined
+read handles across a compaction, span-cap fallback, the Pallas probe
+parity, the columnar SET decode, and the storage role's read batcher on a
+sim cluster. The slow tier runs the full chaos deck (Cycle +
+MachineAttrition + RebootStorage) once per engine impl on the SAME seed
+and compares keyspace fingerprints — the ISSUE-19 acceptance
+differential.
+"""
+
+import copy
+import json
+import os
+
+import numpy as np
+import pytest
+
+from foundationdb_tpu.core.knobs import SERVER_KNOBS
+from foundationdb_tpu.kv.versioned_map import VersionedMap, canonical_chain
+from foundationdb_tpu.storage_engine.factory import (
+    make_mvcc_window,
+    validate_storage_engine_impl,
+)
+from foundationdb_tpu.storage_engine.tpu_engine import (
+    KeyValueStoreTPU,
+    decode_set_columns,
+)
+
+
+@pytest.fixture
+def knob(monkeypatch):
+    def set_knob(name, value, registry=SERVER_KNOBS):
+        monkeypatch.setattr(registry, name, value)
+
+    return set_knob
+
+
+def _read_all(eng, keys, versions):
+    """One fused dispatch of every (key, version) point; returns values."""
+    h = eng.submit_reads([(k, v) for k in keys for v in versions], [])
+    pv, _ = eng.read_verdicts(h)
+    return pv
+
+
+# ---------------------------------------------------------------------------
+# factory
+# ---------------------------------------------------------------------------
+
+def test_factory_selects_impl_by_knob(knob):
+    assert isinstance(make_mvcc_window(), VersionedMap)
+    knob("STORAGE_ENGINE_IMPL", "tpu")
+    assert isinstance(make_mvcc_window(), KeyValueStoreTPU)
+    assert isinstance(make_mvcc_window("memory"), VersionedMap)
+
+
+def test_factory_rejects_unknown_impl(knob):
+    knob("STORAGE_ENGINE_IMPL", "rocksdb")
+    with pytest.raises(ValueError, match="memory|tpu"):
+        validate_storage_engine_impl()
+
+
+# ---------------------------------------------------------------------------
+# visibility / differential
+# ---------------------------------------------------------------------------
+
+def test_point_reads_match_oracle_differential():
+    rng = np.random.default_rng(5)
+    eng = KeyValueStoreTPU(n_words=2, block_slots=8)
+    oracle = VersionedMap()
+    v = 10
+    keys = [b"k%03d" % i for i in range(40)]
+    for step in range(150):
+        k = keys[int(rng.integers(0, len(keys)))]
+        op = rng.random()
+        if op < 0.55:
+            val = b"v%d" % step
+            eng.set(k, val, v)
+            oracle.set(k, val, v)
+        elif op < 0.75:
+            eng.clear(k, v)
+            oracle.clear(k, v)
+        elif op < 0.85:
+            fv = v - int(rng.integers(0, 30))
+            eng.forget_before(fv)
+            oracle.forget_before(fv)
+        v += int(rng.integers(1, 3))
+        if step % 25 == 24:
+            vs = [v, max(oracle.oldest_version, v - 10)]
+            got = _read_all(eng, keys, vs)
+            want = [oracle.get(k, rv) for k in keys for rv in vs]
+            assert got == want, f"divergence at step {step}"
+    assert eng.entries() == oracle.entries()
+
+
+def test_mvcc_version_window_visibility():
+    eng = KeyValueStoreTPU(n_words=1, block_slots=8)
+    eng.set(b"a", b"a1", 10)
+    eng.set(b"a", b"a2", 20)
+    eng.clear(b"a", 30)
+    eng.set(b"a", b"a4", 40)
+    eng.set(b"b", b"b1", 15)
+    eng._compact()  # all entries into the block-sparse base
+    h = eng.submit_reads(
+        [(b"a", rv) for rv in (5, 10, 19, 20, 29, 30, 39, 40, 99)]
+        + [(b"b", 14), (b"b", 15)],
+        [],
+    )
+    pv, _ = eng.read_verdicts(h)
+    assert pv == [None, b"a1", b"a1", b"a2", b"a2", None, None, b"a4",
+                  b"a4", None, b"b1"]
+
+
+def test_delta_tombstone_suppresses_base_value():
+    # A tombstone staged in the delta must hide the compacted base value
+    # — the device keeps tombstones as ordinary entries precisely so a
+    # newer delta clear wins the merge against an older base set.
+    eng = KeyValueStoreTPU(n_words=1, block_slots=8)
+    eng.set(b"x", b"old", 10)
+    eng._compact()
+    eng.clear(b"x", 20)
+    got = _read_all(eng, [b"x"], [15, 25])
+    assert got == [b"old", None]
+    _, rv = eng.read_verdicts(eng.submit_reads(
+        [], [(b"a", b"z", 25, 0, False)]))
+    assert rv == [[]]
+
+
+# ---------------------------------------------------------------------------
+# block layout: boundary-spanning ranges, split/merge of the directory
+# ---------------------------------------------------------------------------
+
+def test_range_reads_span_block_boundaries(knob):
+    # B=8 slots, fill F=4 per block after compaction: 96 keys land in
+    # ~24 blocks, so every multi-key range crosses block fences.
+    knob("STORAGE_TPU_SPAN_CAP", 256)
+    eng = KeyValueStoreTPU(n_words=2, block_slots=8)
+    oracle = VersionedMap()
+    for i in range(96):
+        k, val = b"key%04d" % i, b"val%d" % i
+        eng.set(k, val, 10 + i)
+        oracle.set(k, val, 10 + i)
+    eng._compact()
+    v = 10 + 96
+    cases = [
+        (b"key0000", b"key0100", v, 0, False),   # whole keyspace
+        (b"key0006", b"key0021", v, 0, False),   # mid-block to mid-block
+        (b"key0006", b"key0021", v, 5, False),   # limit
+        (b"key0006", b"key0091", v, 7, True),    # reverse + limit
+        (b"key0000", b"key0050", 30, 0, False),  # old version cut
+        (b"zzz", b"zzzz", v, 0, False),          # past the last fence
+    ]
+    h = eng.submit_reads([], cases)
+    _, rvs = eng.read_verdicts(h)
+    for (b, e, rv, lim, rev), got in zip(cases, rvs):
+        assert got == oracle.get_range(b, e, rv, lim, rev), (b, e, rv)
+    assert eng.c_range_reads.total >= len(cases)
+
+
+def test_block_directory_grows_and_shrinks():
+    # Split/merge analog of the resolver's layout: the fence directory
+    # (NB) must grow when compaction lays out more entries than the
+    # blocks hold, and shrink back once a clear_range empties the window.
+    eng = KeyValueStoreTPU(n_words=2, block_slots=8)
+    nb0 = eng.NB
+    v = 1
+    for i in range(400):
+        eng.set(b"g%05d" % i, b"x", v)
+        v += 1
+    eng._compact()
+    assert eng.NB > nb0, "directory must split across more blocks"
+    assert len(eng) == 400
+    eng.clear_range(b"g", b"h", v)
+    eng.forget_before(v)  # tombstones older than the window get dropped
+    eng._compact()
+    assert eng.NB == nb0, "directory must merge back after the clear"
+    assert len(eng) == 0
+    got = _read_all(eng, [b"g%05d" % i for i in (0, 199, 399)], [v + 1])
+    assert got == [None, None, None]
+
+
+# ---------------------------------------------------------------------------
+# canonicalization + handle pipelining
+# ---------------------------------------------------------------------------
+
+def test_entries_canonical_independent_of_forget_timing():
+    def build(forget_early: bool):
+        e = KeyValueStoreTPU(n_words=1, block_slots=8)
+        e.set(b"p", b"1", 10)
+        e.clear(b"q", 12)
+        if forget_early:
+            e.forget_before(15)
+            e._compact()
+        e.set(b"p", b"2", 20)
+        e.set(b"q", b"3", 21)
+        if not forget_early:
+            e.forget_before(15)
+        return e
+
+    a, b = build(True), build(False)
+    assert a.entries() == b.entries()
+    # And both agree with a VersionedMap fed the same script.
+    o = VersionedMap()
+    o.set(b"p", b"1", 10)
+    o.clear(b"q", 12)
+    o.set(b"p", b"2", 20)
+    o.set(b"q", b"3", 21)
+    o.forget_before(15)
+    assert a.entries() == o.entries()
+
+
+def test_canonical_chain_drops_tombstone_base():
+    assert canonical_chain([(5, b"x"), (8, None), (12, b"y")], 9) == \
+        [(12, b"y")]
+    assert canonical_chain([(5, b"x"), (8, None)], 6) == [(5, b"x"),
+                                                         (8, None)]
+
+
+def test_pipelined_handles_survive_compaction(knob):
+    # A submitted-but-unconsumed handle pins its slot table: a later
+    # submit that triggers compaction (rebinding the engine's table) must
+    # not corrupt the in-flight batch's verdicts.
+    knob("STORAGE_TPU_DELTA_SLOTS", 16)
+    eng = KeyValueStoreTPU(n_words=1, block_slots=8)
+    for i in range(12):
+        eng.set(b"h%02d" % i, b"a%d" % i, 10 + i)
+    h1 = eng.submit_reads([(b"h%02d" % i, 50) for i in range(12)], [])
+    for i in range(40):  # > STORAGE_TPU_DELTA_SLOTS: forces a compaction
+        eng.set(b"z%02d" % i, b"b%d" % i, 30 + i)
+    h2 = eng.submit_reads([(b"z%02d" % i, 99) for i in range(40)], [])
+    assert eng.c_compactions.total >= 1
+    pv2, _ = eng.read_verdicts(h2)
+    pv1, _ = eng.read_verdicts(h1)
+    assert pv1 == [b"a%d" % i for i in range(12)]
+    assert pv2 == [b"b%d" % i for i in range(40)]
+
+
+# ---------------------------------------------------------------------------
+# span fallback / probe impls / columnar decode
+# ---------------------------------------------------------------------------
+
+def test_wide_range_falls_back_to_oracle(knob):
+    knob("STORAGE_TPU_SPAN_CAP", 8)
+    eng = KeyValueStoreTPU(n_words=2, block_slots=8)
+    oracle = VersionedMap()
+    for i in range(64):
+        eng.set(b"w%03d" % i, b"v%d" % i, 10)
+        oracle.set(b"w%03d" % i, b"v%d" % i, 10)
+    eng._compact()
+    before = eng.c_span_fallbacks.total
+    _, rvs = eng.read_verdicts(eng.submit_reads(
+        [], [(b"w", b"x", 11, 0, False)]))
+    assert eng.c_span_fallbacks.total > before
+    assert rvs[0] == oracle.get_range(b"w", b"x", 11)
+
+
+def test_pallas_probe_matches_xla(knob):
+    eng = KeyValueStoreTPU(n_words=2, block_slots=8)
+    for i in range(50):
+        eng.set(b"pp%03d" % i, b"v%d" % i, 10 + i)
+    eng._compact()
+    pts = [(b"pp%03d" % i, 100) for i in range(0, 50, 3)] + [(b"nope", 100)]
+    rgs = [(b"pp000", b"pp020", 100, 0, False)]
+    xla_p, xla_r = eng.read_verdicts(eng.submit_reads(pts, rgs))
+    knob("TPU_PROBE_KERNEL", "pallas")
+    pl_p, pl_r = eng.read_verdicts(eng.submit_reads(pts, rgs))
+    assert pl_p == xla_p
+    assert pl_r == xla_r
+
+
+def test_decode_set_columns_roundtrip():
+    from foundationdb_tpu.cluster.commit_wire import TaggedMutationBatch
+    from foundationdb_tpu.cluster.interfaces import Mutation
+    from foundationdb_tpu.kv.atomic import MutationType
+
+    sets = [Mutation(MutationType.SET_VALUE, b"k%d" % i, b"val%d" % i)
+            for i in range(5)]
+    tmb = TaggedMutationBatch.from_entries([(1234, sets)])
+    tmb = TaggedMutationBatch.from_bytes(tmb.to_bytes())
+    decoded = decode_set_columns(tmb)
+    assert decoded is not None
+    [(ver, keys, vals)] = decoded
+    assert ver == 1234
+    assert keys == [m.param1 for m in sets]
+    assert vals == [m.param2 for m in sets]
+
+    mixed = sets + [Mutation(MutationType.CLEAR_RANGE, b"a", b"b")]
+    tmb2 = TaggedMutationBatch.from_entries([(1235, mixed)])
+    assert decode_set_columns(tmb2) is None
+
+
+def test_key_width_grows_mid_stream():
+    eng = KeyValueStoreTPU(n_words=1, block_slots=8)
+    eng.set(b"ab", b"1", 10)
+    eng.set(b"x" * 40, b"2", 11)   # > 4 bytes: forces a width regrow
+    eng._compact()
+    eng.set(b"y" * 100, b"3", 12)  # and again through the delta path
+    got = _read_all(eng, [b"ab", b"x" * 40, b"y" * 100], [20])
+    assert got == [b"1", b"2", b"3"]
+
+
+# ---------------------------------------------------------------------------
+# storage role wiring (read batcher) — sim tier
+# ---------------------------------------------------------------------------
+
+def test_read_batcher_coalesces_on_sim_cluster(sim, knob):
+    knob("STORAGE_ENGINE_IMPL", "tpu")
+
+    async def main():
+        from foundationdb_tpu.cluster.sharded_cluster import ShardedKVCluster
+        from foundationdb_tpu.workloads.cycle import CycleWorkload
+
+        c = ShardedKVCluster(n_storage=2, shard_boundaries=[b"m"]).start()
+        w = CycleWorkload(c.database(), nodes=8)
+        await w.setup()
+        await w.start(clients=3, txns_per_client=6)
+        assert await w.check()
+        batches = sum(s.read_batches for s in c.storages)
+        engine_reads = sum(
+            s.data.c_point_reads.total + s.data.c_range_reads.total
+            for s in c.storages
+        )
+        assert batches > 0, "reads must route through the batcher"
+        assert engine_reads > 0, "reads must hit the fused device path"
+        for s in c.storages:
+            assert isinstance(s.data, KeyValueStoreTPU)
+        c.stop()
+
+    sim.run(main(), timeout_sim_seconds=300)
+
+
+# ---------------------------------------------------------------------------
+# slow tier: full chaos-deck differential, memory vs tpu
+# ---------------------------------------------------------------------------
+
+_CHAOS_SPEC = {
+    "seed": 60193,
+    "cluster": {
+        "kind": "recoverable_sharded",
+        "n_storage": 4,
+        "n_logs": 2,
+        "replication": "double",
+        "shard_boundaries": ["m"],
+        "topology": {"n_dcs": 1, "machines_per_dc": 4},
+    },
+    "workloads": [
+        {"name": "Cycle", "nodes": 12, "clients": 3, "txns": 15},
+        {"name": "MachineAttrition", "interval": 0.8, "kills": 1,
+         "reboots": 1, "swizzles": 1, "outage": 0.4},
+        {"name": "RebootStorage", "reboots": 2, "interval": 0.7},
+    ],
+}
+
+
+@pytest.mark.slow
+def test_chaos_deck_fingerprint_identical_across_engines():
+    # Same seed, same deck, once per engine impl: the final keyspace
+    # must fingerprint identically — the engine is a pure representation
+    # change, invisible to every durability and recovery path.
+    from foundationdb_tpu.workloads.tester import run_spec
+
+    prints = {}
+    for impl in ("memory", "tpu"):
+        spec = copy.deepcopy(_CHAOS_SPEC)
+        spec["knobs"] = {"server:STORAGE_ENGINE_IMPL": impl}
+        res = run_spec(spec)
+        assert res["ok"], (impl, json.dumps(res)[:2000])
+        prints[impl] = res["fingerprint"]
+    assert prints["memory"] == prints["tpu"], prints

@@ -79,12 +79,17 @@ def test_bias_steers_engine_topology_joint_space():
         cluster = spec["cluster"]
         if cluster.get("engine") and cluster.get("topology"):
             joint += 1
-    # The unbiased generator NEVER draws engine x topology together;
-    # the gated bias must reach the joint space routinely.
+    # The bias must reach the joint space routinely...
     assert joint >= 10
-    for seed in range(30):
+    # ...and the joint space GRADUATED into the unbiased draw (the
+    # WriteDuringRead GRV-coalescing regression it pinned is fixed), so
+    # plain seeds reach it too — just less often than a steered bias.
+    unbiased_joint = 0
+    for seed in range(60):
         cluster = generate_config(seed)["cluster"]
-        assert not (cluster.get("engine") and cluster.get("topology"))
+        if cluster.get("engine") and cluster.get("topology"):
+            unbiased_joint += 1
+    assert 1 <= unbiased_joint < 30
 
 
 def test_bias_forces_knob_bucket():

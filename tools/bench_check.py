@@ -1,16 +1,22 @@
-"""Commit-plane regression guard (ISSUE 18): run a fresh
-`bench.py --commit-plane` ramp and hold its peak against the recorded
-BENCH_r09 floor. The bench artifacts are evidence; this is the tripwire
-that keeps a wire-format or batcher regression from shipping silently —
-wired as a slow-tier test (tests/test_bench_check.py) and runnable
-standalone:
+"""Commit-plane regression guard (ISSUE 18, floor re-anchored ISSUE 19):
+run a fresh `bench.py --commit-plane` ramp and hold its peak against the
+recorded BENCH_r10 floor (2869 commits/s peak — the commit-plane round 2
+artifact superseding r09's 2414). The bench artifacts are evidence; this
+is the tripwire that keeps a wire-format or batcher regression from
+shipping silently — wired as a slow-tier test (tests/test_bench_check.py)
+and runnable standalone:
 
     python tools/bench_check.py            # exits 1 below the floor
 
 The fresh run is deliberately small (no detector-knee study, a short
-stage list around r09's knee region) so the guard costs ~1 minute, and
-the floor has 10% slack for container noise. BENCH_CHECK_FLOOR_FRAC /
+stage list around the knee region) so the guard costs ~1 minute, and the
+floor has 10% slack for container noise. BENCH_CHECK_FLOOR_FRAC /
 BENCH_CHECK_STAGES / BENCH_CHECK_DURATION override the envelope.
+
+Legs whose baseline key is absent from the pinned BENCH file are SKIPPED
+(reported in the verdict, never a KeyError): older artifacts carry only
+the legs that existed at their round, and pointing the guard at one must
+degrade to "nothing to hold" for the missing legs, not crash.
 """
 
 from __future__ import annotations
@@ -22,19 +28,48 @@ import sys
 import tempfile
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-BASELINE = os.path.join(ROOT, "BENCH_r09.json")
+BASELINE = os.path.join(ROOT, "BENCH_r10.json")
+
+
+def baseline_value(key_path, path: str = BASELINE):
+    """Float at `key_path` in the baseline artifact, or None when any
+    key along the path is absent (the leg-skip contract)."""
+    with open(path) as f:
+        node = json.load(f)
+    for k in key_path:
+        if not isinstance(node, dict) or k not in node:
+            return None
+        node = node[k]
+    try:
+        return float(node)
+    except (TypeError, ValueError):
+        return None
 
 
 def baseline_peak(path: str = BASELINE) -> float:
-    with open(path) as f:
-        return float(json.load(f)["commit_plane"]["peak_commits_per_sec"])
+    peak = baseline_value(("commit_plane", "peak_commits_per_sec"), path)
+    if peak is None:
+        raise KeyError(
+            f"{path} has no commit_plane.peak_commits_per_sec baseline"
+        )
+    return peak
 
 
 def run_check(timeout_s: float = 900.0) -> dict:
-    """One fresh ramp vs the r09 floor. Returns the verdict dict; raises
-    on bench harness failure (a broken bench is a failure, not a pass)."""
+    """One fresh ramp vs the pinned floor. Returns the verdict dict;
+    raises on bench harness failure (a broken bench is a failure, not a
+    pass). A baseline file without the commit-plane key yields a skipped
+    leg and ok=True — there is nothing to hold the fresh run against."""
     floor_frac = float(os.environ.get("BENCH_CHECK_FLOOR_FRAC", 0.9))
-    ref = baseline_peak()
+    ref = baseline_value(("commit_plane", "peak_commits_per_sec"))
+    if ref is None:
+        return {
+            "baseline": os.path.basename(BASELINE),
+            "skipped_legs": ["commit_plane"],
+            "reason": "baseline key commit_plane.peak_commits_per_sec "
+                      "absent; nothing to hold against",
+            "ok": True,
+        }
     floor = floor_frac * ref
     with tempfile.TemporaryDirectory(prefix="bench_check_") as td:
         out = os.path.join(td, "fresh.json")
@@ -62,6 +97,7 @@ def run_check(timeout_s: float = 900.0) -> dict:
     peak = float(fresh["commit_plane"]["peak_commits_per_sec"])
     wm = fresh.get("wire_micro", {})
     return {
+        "baseline": os.path.basename(BASELINE),
         "baseline_peak_commits_per_sec": ref,
         "floor_commits_per_sec": round(floor, 1),
         "fresh_peak_commits_per_sec": peak,
@@ -71,6 +107,7 @@ def run_check(timeout_s: float = 900.0) -> dict:
             for s in fresh["commit_plane"]["stages"]
         ],
         "wire_micro_reduction_x": wm.get("per_request_reduction_x"),
+        "skipped_legs": [],
         "ok": peak >= floor,
     }
 

@@ -626,17 +626,19 @@ class _DonationScan:
 # ---------------------------------------------------------------------------
 #
 # The resolver pipeline's whole point is that NOTHING between a batch's
-# dispatch (resolve_async / submit) and its verdict consumption blocks on
-# the device: one stray np.asarray on an in-flight handle re-serializes
-# the pipeline and silently erases the overlap the depth knob configures.
-# Host syncs on handles are fenced into the designated consumption sites;
-# anywhere else in the package they are a finding.
+# dispatch (resolve_async / submit / submit_reads) and its verdict
+# consumption blocks on the device: one stray np.asarray on an in-flight
+# handle re-serializes the pipeline and silently erases the overlap the
+# depth knob configures. Host syncs on handles are fenced into the
+# designated consumption sites; anywhere else in the package they are a
+# finding. The storage engine's read pipeline (submit_reads /
+# read_verdicts) carries the same contract as the resolver's.
 
-_PIPELINE_PRODUCERS = {"resolve_async", "submit"}
+_PIPELINE_PRODUCERS = {"resolve_async", "submit", "submit_reads"}
 # The designated consumption sites (function names): the handle/driver
 # boundary where the one host sync per batch belongs.
 _PIPELINE_SINKS = {"result", "_finish", "collect_results", "verdicts",
-                   "resolve_packed", "resolve"}
+                   "resolve_packed", "resolve", "read_verdicts"}
 _PIPELINE_SYNC_CALLS = {"numpy.asarray", "numpy.array",
                         "jax.block_until_ready", "jax.device_get"}
 # Device arrays riding handles: syncing these is syncing the handle.

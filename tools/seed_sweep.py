@@ -61,6 +61,10 @@ def regions_spec(seed: int) -> dict:
             ("native", "native", "oracle", "tpu")
         )
     if rng.random() < 0.5:
+        knobs["server:STORAGE_ENGINE_IMPL"] = rng.choice(
+            ("memory", "memory", "tpu")
+        )
+    if rng.random() < 0.5:
         knobs["server:LOG_PUSH_RETRIES"] = rng.randint(1, 4)
     if rng.random() < 0.5:
         knobs["server:LOG_PUSH_RETRY_DELAY"] = round(
@@ -261,8 +265,11 @@ def main() -> int:
         # The drawn cluster SHAPE rides every line (and the repro block):
         # an engine- or kind-specific failure is namable at a glance.
         shape = spec.get("cluster", {})
+        impl = spec.get("knobs", {}).get(
+            "server:STORAGE_ENGINE_IMPL", "memory")
         shape_s = (f" kind={shape.get('kind', 'local')}"
                    f" engine={shape.get('engine', 'memory')}"
+                   f" impl={impl}"
                    f" replication={shape.get('replication', '-')}")
         line = f"[seed {seed}] {'ok' if ok else 'FAIL'}{detail}{shape_s}"
         if not ok:
