@@ -568,6 +568,10 @@ class Transaction:
         self._maybe_sample_debug_id()
         req = CommitTransactionRequest(
             read_snapshot=snapshot,
+            # commit() is single-flight per transaction; the client API is
+            # not re-entered while the GRV above is parked, so the
+            # conflict sets cannot move between the test and this read.
+            # fdblint: allow[await-stale-guard] -- single-flight commit
             read_conflict_ranges=tuple(self._read_conflicts),
             write_conflict_ranges=tuple(self._extra_write_conflicts),
             mutations=tuple(self._mutation_log),
@@ -589,14 +593,20 @@ class Transaction:
         """Best-effort: arming failures resolve the watch handle with the
         error rather than raising — by this point the commit is durable, so
         commit() must report success regardless (a raise here would make
-        the caller's retry loop double-apply a committed transaction)."""
-        for w in self._watch_list:
-            try:
-                value = await self.get(w.key, snapshot=True)
-                w._arm(version, value)
-            except BaseException as e:  # noqa: BLE001
-                w._fail(e)
-        self._watch_list = []
+        the caller's retry loop double-apply a committed transaction).
+
+        Drains in batches rather than one iterate-then-clear pass: watch()
+        is synchronous and can run while an arming read is parked, so a
+        trailing ``self._watch_list = []`` would silently drop any handle
+        registered mid-arm — it would never fire and never fail."""
+        while self._watch_list:
+            batch, self._watch_list = self._watch_list, []
+            for w in batch:
+                try:
+                    value = await self.get(w.key, snapshot=True)
+                    w._arm(version, value)
+                except BaseException as e:  # noqa: BLE001
+                    w._fail(e)
 
     async def on_error(self, err: BaseException) -> None:
         """Backoff-and-reset for retryable errors, re-raise otherwise;

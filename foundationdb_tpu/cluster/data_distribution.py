@@ -98,6 +98,11 @@ async def move_keys(cluster, r: KeyRange, new_team: Sequence[int],
             union = tuple(sorted(set(team) | set(new_team)))
             cluster.shard_map.set_team(KeyRange(b, e), union)
         try:
+            # The fetch-buffering window is DESIGNED to stay open across
+            # this await: destinations buffer atomics until the snapshot
+            # lands, and the except arm below rolls the window back on
+            # every failure path.
+            # fdblint: allow[await-lock-hold] -- designed buffering window
             await _move_keys_fetch_finish(
                 cluster, r, new_team, old_slices, old_members, dests,
                 avoid_donors,

@@ -102,6 +102,9 @@ class FailureDetectionServer:
             if req.known_generation == self.state.generation:
                 # Long-poll: answer on the next change (delta behavior).
                 await self._state.on_change()
+            # The fresh read IS the point: the long-poll parks precisely
+            # so the state can move, then answers with what it moved to.
+            # fdblint: allow[await-stale-guard] -- long-poll wants fresh state
             return self.state
         raise TypeError(f"unknown failure-monitor request {type(req)}")
 

@@ -474,6 +474,14 @@ class CommitProxy:
                 ).error(e).log()
                 return
             self._grv_confirmed_at = loop.now()
+        if getattr(self, "_epoch_dead", False):
+            # Re-check the latch: a CONCURRENT batch can prove this
+            # generation deposed (TLogStopped -> _epoch_dead) while this
+            # one was parked in the buggify delay or its own confirm
+            # round-trip raced the fencing. The version at `v` was read
+            # before that proof — answering with it now would hand out a
+            # possibly-stale snapshot the entry check can no longer catch.
+            return
         TraceEvent("ProxyGRV").detail("Version", v).detail(
             "Count", len(reqs)
         ).log()

@@ -362,13 +362,27 @@ class ResolverRole:
         chain (see module docstring). The depth bound parks the dispatch
         until enough older verdicts were consumed."""
         depth = max(1, SERVER_KNOBS.TPU_PIPELINE_DEPTH)
-        if len(self._inflight_q) >= depth:
+        while len(self._inflight_q) >= depth:
             # Ascending in-flight versions; consuming through the
             # (len-depth)-th leaves depth-1 in flight. Older windows'
             # consumption never needs this coroutine, so parking here
-            # cannot deadlock the chain.
+            # cannot deadlock the chain. The while re-checks because
+            # several parked dispatches can wake on one consumption bump
+            # and must not overshoot the depth bound together.
             target = self._inflight_q[len(self._inflight_q) - depth]
             await self._consumed.when_at_least(target)
+        if self.version.get() != req.prev_version:
+            # The chain moved while this dispatch was parked at the depth
+            # gate: the proxy timed the window out and compensated with
+            # skip_window (or retried it, and the twin already dispatched).
+            # resolve_batch's pre-check ran before the park, so it cannot
+            # see this; dispatching now would re-merge the window's writes
+            # into the conflict state. Refuse exactly like the pre-check.
+            raise OperationFailed(
+                f"resolver window ({req.prev_version}, {req.version}] "
+                f"superseded at version {self.version.get()} while parked "
+                "at the pipeline depth gate"
+            )
         batch = self._batch_for_cs(req, wb, wants_wire=True)
         try:
             handle = self.cs.submit(req.version, new_oldest, batch)

@@ -533,6 +533,11 @@ class StorageServer:
                         < SERVER_KNOBS.STORAGE_READ_BATCH_MAX):
                     # the coalescing window: let concurrent readers pile on
                     await loop.delay(SERVER_KNOBS.STORAGE_READ_BATCH_INTERVAL)
+                # The slice re-reads the queue FRESH after the coalescing
+                # park (that is the point: concurrent readers pile on),
+                # and each request re-checks oldest_version below; the
+                # PR 19 bug was snapshotting before the park, not after.
+                # fdblint: allow[await-stale-guard] -- fresh re-read after park
                 batch = self._read_batch_q[
                     : int(SERVER_KNOBS.STORAGE_READ_BATCH_MAX)
                 ]

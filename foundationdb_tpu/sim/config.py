@@ -101,6 +101,23 @@ _KNOB_RANGES = [
     # buckets aggressively mid-workload, so the clear_range prune path
     # runs inside the chaos mix instead of only at operator horizons.
     ("METRICS_RETENTION_SECONDS", "server", (5.0, 120.0)),
+    # r20 (knob-unrandomized sweep): storage fsync cadence and the read
+    # batcher's coalescing window — 0.0 pins the no-coalesce path, the
+    # upper end widens the park the PR 19 regression lived in.
+    ("STORAGE_COMMIT_INTERVAL", "server", (0.05, 1.0)),
+    ("STORAGE_READ_BATCH_INTERVAL", "server", (0.0, 0.005)),
+    # r20: failure-detector horizon vs heartbeat cadence — draws near
+    # WORKER_HEARTBEAT_INTERVAL make liveness flap under chaos.
+    ("FAILURE_TIMEOUT_DELAY", "server", (0.5, 4.0)),
+    # r20: the deployed default (1.5 GB) never spills in a sim-sized
+    # run; low draws push durable tlog entries through the spill store
+    # and its peek-from-spill read path mid-workload.
+    ("TLOG_SPILL_THRESHOLD", "server", (65536.0, 4194304.0)),
+    # r20: client commit-wire coalescing window/size — 0.0 disables the
+    # interval (every request ships alone), small COUNT_MAX forces
+    # mid-burst flushes.
+    ("COMMIT_WIRE_BATCH_INTERVAL", "client", (0.0, 0.005)),
+    ("COMMIT_WIRE_BATCH_COUNT_MAX", "client", (4, 512)),
 ]
 
 # Categorical knob draws (same subset-randomization policy as the ranges).
@@ -126,6 +143,11 @@ _KNOB_CHOICES = [
     # keyspace fingerprint under either draw — the swarm holds that
     # differential live. Weighted toward the host default.
     ("STORAGE_ENGINE_IMPL", "server", ("memory", "memory", "tpu")),
+    # r20 (knob-unrandomized sweep): client GRV batching and the commit
+    # wire batcher on/off — the "false" draws pin the unbatched legacy
+    # paths, which no fixed default exercised since they landed.
+    ("GRV_COALESCE", "client", ("true", "false")),
+    ("COMMIT_WIRE_BATCH", "client", ("true", "false")),
 ]
 
 _REPLICATION_FOR = {3: ["single", "double", "triple"],

@@ -126,6 +126,9 @@ class RollbackWorkload:
                 await loop.delay(0.1)
 
     async def check(self) -> bool:
+        # The harness runs check() strictly after every run() finished;
+        # nothing appends to acked once the verification phase starts.
+        # fdblint: allow[await-iter-invalidate] -- phases are sequential
         for i in self.acked:
             got = await self.db.get(self.prefix + b"%04d" % i)
             if got != b"v%d" % i:

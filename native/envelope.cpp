@@ -38,11 +38,15 @@ PyObject* g_intenum = nullptr;        // enum.IntEnum
 PyObject* g_fields_fn = nullptr;      // dataclasses.fields
 PyObject* g_fields_cache = nullptr;   // dict: type -> tuple of name str
 
+// fdblint:tag-table — must mirror the _T_* grammar in core/serialize.py;
+// tools/fdblint rule native-grammar-sync cross-checks every tag by name
+// and value between these anchors and the Python oracle.
 constexpr uint8_t T_NONE = 0, T_TRUE = 1, T_FALSE = 2;
 constexpr uint8_t T_INT = 3, T_BIGINT = 4, T_FLOAT = 5;
 constexpr uint8_t T_BYTES = 6, T_STR = 7;
 constexpr uint8_t T_LIST = 8, T_TUPLE = 9, T_DICT = 10;
 constexpr uint8_t T_ENUM = 11, T_OBJ = 12, T_ERROR = 13;
+// fdblint:tag-table end
 
 struct Buf {
     std::string s;

@@ -175,6 +175,44 @@ def test_watch_fires_on_change():
     run_sim(main)
 
 
+def test_watch_registered_mid_arm_is_not_dropped():
+    """watch() is synchronous and can run while an arming read is parked.
+    The arming drain must re-check the list after each batch — a single
+    iterate-then-clear pass would silently drop the mid-arm handle: it
+    would never fire and never fail."""
+    async def main(db):
+        from foundationdb_tpu.core.runtime import current_loop
+
+        await db.set(b"w1", b"a")
+        await db.set(b"w2", b"a")
+        tr = db.create_transaction()
+        tr.set(b"t", b"1")
+        tr.watch(b"w1")
+        real_get = tr.get
+        mid_arm = []
+
+        async def get_hook(key, **kw):
+            # Runs inside _arm_watches; registering here lands the new
+            # handle on the list the drain already snapshotted.
+            if not mid_arm:
+                mid_arm.append(tr.watch(b"w2"))
+            return await real_get(key, **kw)
+
+        tr.get = get_hook
+        await tr.commit()
+        assert mid_arm, "arming read never went through the hook"
+
+        async def writer():
+            await current_loop().delay(0.5)
+            await db.set(b"w2", b"b")
+
+        w = spawn(writer(), name="mid_arm_writer")
+        assert await mid_arm[0].wait() > 0
+        await w.done
+
+    run_sim(main)
+
+
 def test_cycle_workload_invariant():
     async def main(db):
         wl = CycleWorkload(db, nodes=12)

@@ -90,6 +90,40 @@ def test_live_generation_grvs_flow(sim):
     sim.run(main())
 
 
+def test_grv_confirm_racing_depose_drops_batch(sim):
+    """The dead-latch re-check after the confirm round-trip: a batch
+    whose own confirm succeeds can still wake to find a CONCURRENT batch
+    proved the generation deposed while it was parked. Its version was
+    read before that proof — the entry check ran pre-park and cannot
+    catch it — so the batch must drop, not answer."""
+    rc = RecoverableCluster().start()
+    db = rc.database()
+
+    async def main():
+        await db.set(b"k", b"v")
+        proxy = rc.proxy
+        real_confirm = proxy._confirm_epoch_live
+
+        async def confirm_then_depose():
+            await real_confirm()
+            # The round-trip itself succeeded, but by the time this
+            # coroutine resumes, another batch latched the proxy dead.
+            proxy._epoch_dead = True
+
+        proxy._confirm_epoch_live = confirm_then_depose
+        proxy._grv_confirmed_at = None  # force the confirm path
+        req = GetReadVersionRequest()
+        proxy.grv_stream.send(req)
+        await current_loop().delay(2.0)
+        assert not req.reply.is_set(), (
+            "GRV answered with a version read before the generation was "
+            "proven deposed — stale-read window"
+        )
+        rc.stop()
+
+    sim.run(main(), timeout_sim_seconds=1e6)
+
+
 def test_confirm_epoch_direct_tlog_raises(sim):
     """Unit: MemoryTLog.confirm_epoch raises exactly when a newer
     generation holds the lock."""

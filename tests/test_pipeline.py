@@ -206,6 +206,64 @@ def test_role_wire_batches_and_sync_path_parity(knob):
         assert role.keys_resolved > 0  # wire-side accounting populated
 
 
+def test_role_parked_dispatch_refuses_superseded_window(knob):
+    """A dispatch parked at the pipeline depth gate must re-check the
+    version chain when it wakes: resolve_batch's pre-check ran before the
+    park, so a skip_window compensation landing meanwhile (proxy timeout
+    over a slow link) would otherwise let the stale window re-merge its
+    writes into the conflict state."""
+    from foundationdb_tpu.cluster.interfaces import (
+        ResolveTransactionBatchRequest,
+    )
+    from foundationdb_tpu.cluster.resolver_role import ResolverRole
+    from foundationdb_tpu.core.errors import OperationFailed
+    from foundationdb_tpu.core.runtime import (
+        current_loop,
+        loop_context,
+        sim_loop,
+        spawn,
+    )
+
+    class RefusingCS:
+        def submit(self, version, new_oldest, batch):
+            raise AssertionError("superseded window must not dispatch")
+
+        def verdicts(self, handle):
+            raise AssertionError("nothing was submitted")
+
+    knob("TPU_PIPELINE_DEPTH", 2)
+    loop = sim_loop(seed=9)
+    with loop_context(loop):
+        role = ResolverRole(RefusingCS(), init_version=0)
+        # Two windows already in flight at the depth bound, chain at 20.
+        role._inflight_q.extend([10, 20])
+        role.version.set(20)
+
+        async def main():
+            req = ResolveTransactionBatchRequest(
+                prev_version=20, version=30,
+                last_receive_version=20, transactions=[],
+            )
+            dispatch = spawn(role.resolve_batch(req), name="parked_w30")
+            await current_loop().delay(0.1)  # park at the depth gate
+            skip = spawn(role.skip_window(20, 30), name="skip_w30")
+            await current_loop().delay(0.1)  # version chain moves to 30
+            # Consume window 10: the parked dispatch drops below the
+            # depth bound, wakes, and must refuse rather than submit.
+            role._inflight_q.popleft()
+            role._consumed.set(10)
+            with pytest.raises(OperationFailed, match="depth gate"):
+                await dispatch.done
+            # Drain window 20 so skip_window's consumption half lands.
+            role._inflight_q.popleft()
+            role._consumed.set(20)
+            await skip.done
+            assert role.version.get() == 30
+            assert role._consumed.get() == 30
+
+        loop.run(main(), timeout_sim_seconds=1e5)
+
+
 def test_pallas_probe_kernel_parity(knob):
     """TPU_PROBE_KERNEL=pallas (interpret mode on CPU) must produce the
     oracle's verdicts and entries — the probe swap is bit-inert."""

@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# Repo CI gate: static analysis first (cheap, catches whole classes of
+# sim-breaking bugs before any test runs), then the tier-1 suite with
+# the exact recipe from ROADMAP.md so local runs and CI agree on what
+# "green" means.
+#
+# Usage:
+#   tools/ci.sh             # full gate: fdblint + tier-1
+#   tools/ci.sh --lint-only # static gate only (pre-commit speed)
+#   tools/ci.sh --changed   # lint findings filtered to changed files
+#                           # (tree still analyzed for call-graph rules)
+set -u
+cd "$(dirname "$0")/.."
+
+LINT_ARGS=()
+LINT_ONLY=0
+for arg in "$@"; do
+    case "$arg" in
+        --lint-only) LINT_ONLY=1 ;;
+        --changed)   LINT_ARGS+=(--changed) ;;
+        --base=*)    LINT_ARGS+=(--base "${arg#--base=}") ;;
+        *) echo "ci.sh: unknown argument: $arg" >&2; exit 2 ;;
+    esac
+done
+
+echo "== fdblint (canonical scope: foundationdb_tpu tests tools) =="
+python -m tools.fdblint "${LINT_ARGS[@]+"${LINT_ARGS[@]}"}" \
+    foundationdb_tpu tests tools
+lint_rc=$?
+if [ "$lint_rc" -ne 0 ]; then
+    echo "ci.sh: fdblint gate FAILED (rc=$lint_rc)" >&2
+    exit "$lint_rc"
+fi
+if [ "$LINT_ONLY" -eq 1 ]; then
+    exit 0
+fi
+
+echo "== tier-1 (ROADMAP.md recipe) =="
+# Verbatim tier-1 recipe from ROADMAP.md — keep the two in sync.
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -10,3 +10,17 @@ instead of by convention.  See tools/fdblint/README.md.
 """
 
 from .core import Finding, lint_paths, main  # noqa: F401
+
+# Bumped whenever a round of rules lands (round 1 = PR 4's original
+# packs, round 2 = interprocedural await-interference + wire-schema
+# drift).  Stamped into sweep/swarm repro blocks via gate_signature().
+__version__ = "2.0"
+
+
+def gate_signature() -> str:
+    """``fdblint <version> (<N> rules)`` — repro blocks carry this so a
+    distilled failure records which static-gate generation the tree
+    passed when the failure was found (a seed that only reproduces on
+    an older tree is diagnosable from the spec alone)."""
+    from .core import RULES
+    return f"fdblint {__version__} ({len(RULES)} rules)"

@@ -223,7 +223,7 @@ def _check_grv_cache(ctx: FileCtx) -> list[Finding]:
     if not ctx.path.startswith("foundationdb_tpu/"):
         return []
     findings: list[Finding] = []
-    for node in ast.walk(ctx.tree):
+    for node in ctx.nodes():
         if not isinstance(node, ast.AsyncFunctionDef):
             continue
         if "grv" not in node.name.lower():

@@ -1,0 +1,593 @@
+"""Rule pack 8 — interprocedural await-interference analysis.
+
+The repo's costliest bug class is cross-await interference: a coroutine
+checks shared mutable state, suspends, and acts on the stale conclusion
+while another coroutine moved the state underneath it (PR 19's storage
+batcher re-checking the MVCC window after parking is the canonical
+shape; FDB's actor compiler polices the same discipline for state
+variables across ``wait()``).  Three rules:
+
+* await-stale-guard — a condition tested on shared mutable state (a
+  ``self`` attribute, a module-global collection, or a closure cell
+  shared via ``nonlocal``) dominates a suspension point, and the guarded
+  state is used after the suspension without an intervening re-check
+  (``if``/``while``/``assert`` naming it) or refresh (assignment).  Two
+  sub-shapes: the *guard* shape (``if self.q: ... await ...; use self.q``
+  — flow-sensitive within the function; ``while``-guards are exempt
+  because the loop header re-tests on every wake), and the *latch* shape
+  (an early-return ``if self.dead: return`` dominating a suspension,
+  with a ``.reply.send(...)`` effect after the suspension and no
+  re-test, where some coroutine in the project can flip the latch — the
+  write that matters is one that can happen *during* the suspension, so
+  only latches assigned inside an ``async def`` qualify).
+
+* await-iter-invalidate — iterating a shared dict/list/set (``for x in
+  self.coll`` or ``.keys()/.values()/.items()``) with a suspension in
+  the loop body while any other function in the project mutates that
+  collection (method mutators, subscript stores/deletes, or rebinding).
+  Iterating a snapshot (``list(self.coll)``, ``sorted(...)``, a slice)
+  is the safe idiom and is not flagged.
+
+* await-lock-hold — suspending while holding a non-async critical
+  section: a ``with`` block on a ``threading.Lock``/``RLock`` attribute,
+  a ``with`` block whose context manager's body takes ``fcntl.flock``
+  (resolved through the project call graph), or between paired
+  ``begin_X(...)`` / ``end_X``/``abort_X`` registry-mutation calls in
+  the same function.
+
+Suspension points are ``await``, ``async for``, ``async with``, and
+``yield`` inside an ``async def`` (an async generator parks at every
+yield).  Nested function definitions do not suspend their enclosing
+frame and are excluded from every scan.
+"""
+
+from __future__ import annotations
+
+import ast
+import itertools
+from typing import Iterable, Optional
+
+from .core import FileCtx, Finding
+from .rules_jax import _Project
+
+_MUTATORS = {
+    "append", "add", "extend", "insert", "pop", "popitem", "remove",
+    "discard", "clear", "update", "setdefault", "appendleft", "popleft",
+    "extendleft",
+}
+_SNAPSHOT_CALLS = {"list", "tuple", "sorted", "set", "frozenset", "dict"}
+_LOCK_FACTORIES = {"threading.Lock", "threading.RLock"}
+_PAIR_BEGIN = "begin_"
+_PAIR_ENDS = ("end_", "abort_", "release_")
+
+
+# ---------------------------------------------------------------------------
+# Shallow AST walks (never descend into nested function definitions:
+# a nested def's awaits suspend ITS frame, not the enclosing one)
+# ---------------------------------------------------------------------------
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _walk_shallow(node: ast.AST) -> Iterable[ast.AST]:
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, _FUNC_NODES):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+def _suspends(node: ast.AST, *, in_async: bool = True) -> Optional[int]:
+    """Line of the first suspension point in ``node`` (shallow), or None."""
+    best: Optional[int] = None
+    for n in _walk_shallow(node):
+        hit = isinstance(n, (ast.Await, ast.AsyncFor, ast.AsyncWith)) or (
+            in_async and isinstance(n, (ast.Yield, ast.YieldFrom))
+        )
+        if hit and (best is None or n.lineno < best):
+            best = n.lineno
+    if best is None and isinstance(node, (ast.Await, ast.AsyncFor,
+                                          ast.AsyncWith)):
+        best = node.lineno
+    return best
+
+
+# ---------------------------------------------------------------------------
+# Shared-state keys: ("attr", name) | ("global", name) | ("cell", name)
+# ---------------------------------------------------------------------------
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id in ("self", "cls")):
+        return node.attr
+    return None
+
+
+def _getattr_self(node: ast.AST) -> Optional[str]:
+    """getattr(self, "attr", default) -> "attr"."""
+    if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+            and node.func.id == "getattr" and len(node.args) >= 2
+            and isinstance(node.args[0], ast.Name)
+            and node.args[0].id in ("self", "cls")
+            and isinstance(node.args[1], ast.Constant)
+            and isinstance(node.args[1].value, str)):
+        return node.args[1].value
+    return None
+
+
+class _Shared:
+    """Per-function view of which names are shared mutable state."""
+
+    def __init__(self, module_globals: set[str], cells: set[str]):
+        self.module_globals = module_globals
+        self.cells = cells
+
+    def key_of(self, node: ast.AST) -> Optional[tuple[str, str]]:
+        a = _self_attr(node)
+        if a is not None:
+            return ("attr", a)
+        if isinstance(node, ast.Name):
+            if node.id in self.cells:
+                return ("cell", node.id)
+            if node.id in self.module_globals:
+                return ("global", node.id)
+        return None
+
+    def tested_keys(self, test: ast.AST) -> set[tuple[str, str]]:
+        """Shared state whose VALUE the test reads.  An attribute that
+        only appears as a call receiver (``self.topo.kill(...)``) is not
+        a value test — the tested thing is the call's result."""
+        keys: set[tuple[str, str]] = set()
+        receivers: set[tuple[str, str]] = set()
+        for n in ast.walk(test):
+            k = self.key_of(n)
+            if k is not None:
+                keys.add(k)
+            g = _getattr_self(n)
+            if g is not None:
+                keys.add(("attr", g))
+            if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute):
+                rk = self.key_of(n.func.value)
+                if rk is not None:
+                    receivers.add(rk)
+                if (isinstance(n.func.value, ast.Name)
+                        and n.func.value.id in ("self", "cls")):
+                    receivers.add(("attr", n.func.attr))  # self.method()
+        return keys - receivers
+
+    def used_keys(self, stmt: ast.AST) -> dict[tuple[str, str], int]:
+        out: dict[tuple[str, str], int] = {}
+        for n in _walk_shallow(stmt):
+            if isinstance(n, ast.Attribute) and not isinstance(
+                    n.ctx, ast.Load):
+                continue
+            k = self.key_of(n)
+            if k is not None and k not in out:
+                out[k] = n.lineno
+        return out
+
+    def assigned_keys(self, stmt: ast.AST) -> set[tuple[str, str]]:
+        out: set[tuple[str, str]] = set()
+        # _walk_shallow yields children only: chain the statement itself
+        # so a bare ``self.q = ...`` refresh counts as a kill.
+        for n in itertools.chain((stmt,), _walk_shallow(stmt)):
+            targets: list[ast.AST] = []
+            if isinstance(n, ast.Assign):
+                targets = list(n.targets)
+            elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+                targets = [n.target]
+            for t in targets:
+                for el in ast.walk(t):
+                    k = self.key_of(el)
+                    if k is not None:
+                        out.add(k)
+        return out
+
+
+def _module_mutable_globals(tree: ast.Module) -> set[str]:
+    """Module-level names bound to mutable literals (list/dict/set)."""
+    out: set[str] = set()
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and isinstance(
+                stmt.value, (ast.List, ast.Dict, ast.Set, ast.DictComp,
+                             ast.ListComp, ast.SetComp)):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def _cells_of(fn: ast.AST) -> set[str]:
+    """Names shared between this function and its nested defs via
+    ``nonlocal`` — closure cells a sibling closure can mutate."""
+    out: set[str] = set()
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Nonlocal):
+            out.update(n.names)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Project-wide mutator / writer indexes
+# ---------------------------------------------------------------------------
+
+def _func_label(ctx: FileCtx, name: str) -> str:
+    return f"{ctx.path}:{name}"
+
+
+class _SharedIndex:
+    """Who, anywhere in the project, mutates shared attribute ``X`` —
+    and which attributes are (re)assigned inside a coroutine (and so can
+    flip while another coroutine is suspended)."""
+
+    def __init__(self, project: _Project):
+        # attr -> {function labels that mutate self.<attr>}
+        self.attr_mutators: dict[str, set[str]] = {}
+        # attr -> True when assigned inside any async def
+        self.attr_async_written: set[str] = set()
+        # (module, name) -> {labels mutating the module global}
+        self.global_mutators: dict[tuple[str, str], set[str]] = {}
+        for ctx in project.ctxs:
+            idx = project.indexers[ctx.path]
+            for fi in idx.funcs:
+                if not fi.name:
+                    continue
+                label = _func_label(ctx, fi.label)
+                is_async = isinstance(fi.node, ast.AsyncFunctionDef)
+                for n in _walk_shallow(fi.node):
+                    self._scan_node(ctx, label, is_async, n)
+
+    def _note_attr(self, attr: str, label: str, is_async: bool) -> None:
+        self.attr_mutators.setdefault(attr, set()).add(label)
+        if is_async:
+            self.attr_async_written.add(attr)
+
+    def _scan_node(self, ctx: FileCtx, label: str, is_async: bool,
+                   n: ast.AST) -> None:
+        # self.X.append(...) / shared_global.update(...)
+        if (isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+                and n.func.attr in _MUTATORS):
+            a = _self_attr(n.func.value)
+            if a is not None:
+                self._note_attr(a, label, is_async)
+            elif isinstance(n.func.value, ast.Name):
+                self.global_mutators.setdefault(
+                    (ctx.module, n.func.value.id), set()).add(label)
+        # self.X = ... / self.X[k] = ... / del self.X[k]
+        targets: list[ast.AST] = []
+        if isinstance(n, ast.Assign):
+            targets = list(n.targets)
+        elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+            targets = [n.target]
+        elif isinstance(n, ast.Delete):
+            targets = list(n.targets)
+        for t in targets:
+            base = t.value if isinstance(t, ast.Subscript) else t
+            a = _self_attr(base)
+            if a is not None:
+                self._note_attr(a, label, is_async)
+            elif isinstance(t, ast.Subscript) and isinstance(base, ast.Name):
+                self.global_mutators.setdefault(
+                    (ctx.module, base.id), set()).add(label)
+
+
+# ---------------------------------------------------------------------------
+# await-stale-guard
+# ---------------------------------------------------------------------------
+
+def _is_early_exit(body: list[ast.stmt]) -> bool:
+    return bool(body) and isinstance(
+        body[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break))
+
+
+def _fmt_key(key: tuple[str, str]) -> str:
+    kind, name = key
+    return f"self.{name}" if kind == "attr" else name
+
+
+class _StaleGuardScan:
+    def __init__(self, ctx: FileCtx, fn: ast.AsyncFunctionDef,
+                 shared: _Shared, index: _SharedIndex):
+        self.ctx = ctx
+        self.fn = fn
+        self.shared = shared
+        self.index = index
+        self.findings: list[Finding] = []
+
+    def run(self) -> list[Finding]:
+        self._scan_stmts(self.fn.body, {})
+        self._scan_latch()
+        return self.findings
+
+    # -- guard shape --------------------------------------------------------
+    def _flag(self, key: tuple[str, str], use_line: int, guard_line: int,
+              await_line: int) -> None:
+        self.findings.append(Finding(
+            self.ctx.path, use_line, "await-stale-guard",
+            f"{_fmt_key(key)} was tested (line {guard_line}) to guard a "
+            f"suspension (line {await_line}) and is used here without "
+            "re-checking — the state can move while the coroutine is "
+            "parked; re-test it, refresh it, or convert the guard to a "
+            "while loop"))
+
+    def _scan_stmts(self, stmts: list[ast.stmt],
+                    pending: dict[tuple[str, str], tuple[int, int]]) -> None:
+        """Linear scan of one block.  ``pending`` maps a shared-state key
+        to (guard_line, await_line) once its guard's suspension happened."""
+        for s in stmts:
+            if pending:
+                # kills first (generous): a re-test or refresh anywhere in
+                # this statement clears the hazard before uses are checked.
+                for n in _walk_shallow(s):
+                    if isinstance(n, (ast.If, ast.While)):
+                        for k in self.shared.tested_keys(n.test):
+                            pending.pop(k, None)
+                if isinstance(s, (ast.If, ast.While)):
+                    for k in self.shared.tested_keys(s.test):
+                        pending.pop(k, None)
+                elif isinstance(s, ast.Assert):
+                    for k in self.shared.tested_keys(s.test):
+                        pending.pop(k, None)
+                for k in self.shared.assigned_keys(s):
+                    pending.pop(k, None)
+                for k, line in sorted(self.shared.used_keys(s).items()):
+                    if k in pending:
+                        gl, al = pending.pop(k)
+                        self._flag(k, line, gl, al)
+            if (isinstance(s, ast.If) and not s.orelse
+                    and not _is_early_exit(s.body)):
+                keys = self.shared.tested_keys(s.test)
+                await_line = _suspends(s)
+                if keys and await_line is not None:
+                    self._scan_guard_body(s, keys)
+                    for k in keys:
+                        pending[k] = (s.lineno, await_line)
+                    continue
+            # recurse into compound statements with a fresh pending set
+            # (uses inside them were already checked shallowly above)
+            for blk in ("body", "orelse", "finalbody"):
+                sub = getattr(s, blk, None)
+                if sub and not isinstance(s, _FUNC_NODES):
+                    self._scan_stmts(sub, {})
+            for h in getattr(s, "handlers", []):
+                self._scan_stmts(h.body, {})
+
+    def _scan_guard_body(self, guard: ast.If,
+                         keys: set[tuple[str, str]]) -> None:
+        """Uses of the guarded state inside the guard's own body, after
+        its first suspending statement (the PR 19 batcher shape)."""
+        live = dict.fromkeys(keys)
+        awaited: Optional[int] = None
+        for s in guard.body:
+            if awaited is not None and live:
+                for n in _walk_shallow(s):
+                    if isinstance(n, (ast.If, ast.While)):
+                        for k in self.shared.tested_keys(n.test):
+                            live.pop(k, None)
+                if isinstance(s, ast.Assert):
+                    for k in self.shared.tested_keys(s.test):
+                        live.pop(k, None)
+                for k in self.shared.assigned_keys(s):
+                    live.pop(k, None)
+                for k, line in sorted(self.shared.used_keys(s).items()):
+                    if k in live:
+                        live.pop(k)
+                        self._flag(k, line, guard.lineno, awaited)
+            if awaited is None:
+                awaited = _suspends(s)
+
+    # -- latch shape --------------------------------------------------------
+    def _scan_latch(self) -> None:
+        """``if self.dead: return`` dominating a suspension, with a
+        ``.reply.send(...)`` after the suspension and no re-test — when
+        some coroutine in the project can flip the latch mid-park."""
+        latches: dict[tuple[str, str], int] = {}
+        awaited: Optional[int] = None
+        for s in self.fn.body:
+            if (awaited is None and isinstance(s, ast.If)
+                    and _is_early_exit(s.body) and not s.orelse):
+                for k in self.shared.tested_keys(s.test):
+                    if k[0] == "attr" and k[1] in self.index.attr_async_written:
+                        latches.setdefault(k, s.lineno)
+                continue
+            if awaited is not None and latches:
+                # a re-test anywhere — the statement itself or nested in
+                # a compound — clears the latch hazard
+                if isinstance(s, (ast.If, ast.While)):
+                    for k in self.shared.tested_keys(s.test):
+                        latches.pop(k, None)
+                for n in _walk_shallow(s):
+                    if isinstance(n, (ast.If, ast.While)):
+                        for k in self.shared.tested_keys(n.test):
+                            latches.pop(k, None)
+                send_line = self._reply_send_line(s)
+                if send_line is not None:
+                    for k, ln in sorted(latches.items()):
+                        self.findings.append(Finding(
+                            self.ctx.path, send_line, "await-stale-guard",
+                            f"reply sent after a suspension (line {awaited}) "
+                            f"without re-checking the {_fmt_key(k)} latch "
+                            f"(tested line {ln}) — a concurrent coroutine "
+                            "can flip it while this one is parked; re-test "
+                            "before answering"))
+                    latches.clear()
+            if awaited is None:
+                awaited = _suspends(s)
+
+    def _reply_send_line(self, stmt: ast.stmt) -> Optional[int]:
+        for n in _walk_shallow(stmt):
+            if (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr in ("send", "send_error")
+                    and isinstance(n.func.value, ast.Attribute)
+                    and n.func.value.attr == "reply"):
+                return n.lineno
+        return None
+
+
+# ---------------------------------------------------------------------------
+# await-iter-invalidate
+# ---------------------------------------------------------------------------
+
+def _iter_target_key(shared: _Shared, it: ast.AST) -> Optional[tuple[str, str]]:
+    """The shared collection a ``for`` iterates directly (no snapshot)."""
+    if (isinstance(it, ast.Call) and isinstance(it.func, ast.Attribute)
+            and it.func.attr in ("keys", "values", "items")
+            and not it.args):
+        it = it.func.value
+    return shared.key_of(it)
+
+
+def _check_iter_invalidate(ctx: FileCtx, fn: ast.AsyncFunctionDef,
+                           shared: _Shared, index: _SharedIndex,
+                           fn_label: str) -> list[Finding]:
+    out: list[Finding] = []
+    for loop in _walk_shallow(fn):
+        if not isinstance(loop, ast.For):
+            continue
+        key = _iter_target_key(shared, loop.iter)
+        if key is None:
+            continue
+        await_line = None
+        for s in loop.body:
+            await_line = _suspends(s)
+            if await_line is not None:
+                break
+        if await_line is None:
+            continue
+        if key[0] == "attr":
+            mutators = index.attr_mutators.get(key[1], set())
+        else:
+            mutators = index.global_mutators.get((ctx.module, key[1]), set())
+        others = sorted(m for m in mutators if m != fn_label)
+        if not others:
+            continue
+        names = ", ".join(m.rsplit(":", 1)[1] for m in others[:3])
+        out.append(Finding(
+            ctx.path, loop.lineno, "await-iter-invalidate",
+            f"iterating {_fmt_key(key)} with a suspension in the loop "
+            f"body (line {await_line}) while {names} can mutate it "
+            "mid-park — iterate a snapshot (list(...)) or drain with a "
+            "while loop"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# await-lock-hold
+# ---------------------------------------------------------------------------
+
+class _LockIndex:
+    def __init__(self, project: _Project):
+        self.lock_attrs: set[str] = set()      # self.X = threading.Lock()
+        self.flock_funcs: set[str] = set()     # function names taking flock
+        for ctx in project.ctxs:
+            for n in ctx.nodes():
+                if (isinstance(n, ast.Assign) and isinstance(n.value, ast.Call)
+                        and ctx.resolve(n.value.func) in _LOCK_FACTORIES):
+                    for t in n.targets:
+                        a = _self_attr(t)
+                        if a is not None:
+                            self.lock_attrs.add(a)
+            idx = project.indexers[ctx.path]
+            for fi in idx.funcs:
+                if not fi.name:
+                    continue
+                for n in ast.walk(fi.node):
+                    if (isinstance(n, ast.Call)
+                            and ctx.resolve(n.func) == "fcntl.flock"):
+                        self.flock_funcs.add(fi.name)
+                        break
+
+
+def _check_lock_hold(ctx: FileCtx, fn: ast.AsyncFunctionDef,
+                     locks: _LockIndex) -> list[Finding]:
+    out: list[Finding] = []
+    # with self._lock: / with self._locked(): containing a suspension
+    for w in _walk_shallow(fn):
+        if not isinstance(w, (ast.With, ast.AsyncWith)):
+            continue
+        held = None
+        for item in w.items:
+            e = item.context_expr
+            a = _self_attr(e)
+            if a is not None and a in locks.lock_attrs:
+                held = f"self.{a}"
+            if isinstance(e, ast.Call):
+                fname = None
+                if isinstance(e.func, ast.Attribute):
+                    fname = e.func.attr
+                elif isinstance(e.func, ast.Name):
+                    fname = e.func.id
+                if fname in locks.flock_funcs:
+                    held = f"{fname}() [flock]"
+        if held is None:
+            continue
+        line = _suspends(ast.Module(body=w.body, type_ignores=[]))
+        if line is not None:
+            out.append(Finding(
+                ctx.path, line, "await-lock-hold",
+                f"suspension while holding non-async critical section "
+                f"{held} (with-block at line {w.lineno}) — every other "
+                "coroutine on the loop is blocked from the section for "
+                "the whole park; release before awaiting"))
+    # begin_X ... await ... end_X / abort_X in one function
+    begins: dict[str, int] = {}
+    ends: dict[str, int] = {}
+    suspensions: list[int] = []
+    for n in _walk_shallow(fn):
+        if isinstance(n, (ast.Await, ast.AsyncWith, ast.AsyncFor,
+                          ast.Yield, ast.YieldFrom)):
+            suspensions.append(n.lineno)
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute):
+            meth = n.func.attr
+            if meth.startswith(_PAIR_BEGIN):
+                suffix = meth[len(_PAIR_BEGIN):]
+                begins.setdefault(suffix, n.lineno)
+            else:
+                for p in _PAIR_ENDS:
+                    if meth.startswith(p):
+                        suffix = meth[len(p):]
+                        prev = ends.get(suffix)
+                        if prev is None or n.lineno > prev:
+                            ends[suffix] = n.lineno
+    for suffix, b_line in sorted(begins.items()):
+        e_line = ends.get(suffix)
+        if e_line is None or e_line <= b_line:
+            continue
+        inside = sorted(ln for ln in suspensions if b_line < ln < e_line)
+        if inside:
+            out.append(Finding(
+                ctx.path, inside[0], "await-lock-hold",
+                f"suspension between begin_{suffix} (line {b_line}) and "
+                f"its paired end (line {e_line}) — the registry-mutation "
+                "window stays open across the park; close it first or "
+                "make the rollback path cancellation-safe"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+def check_project(ctxs: list[FileCtx],
+                  project: Optional[_Project] = None) -> list[Finding]:
+    if project is None:
+        project = _Project(list(ctxs))
+    index = _SharedIndex(project)
+    locks = _LockIndex(project)
+    findings: list[Finding] = []
+    for ctx in project.ctxs:
+        module_globals = _module_mutable_globals(ctx.tree)
+        idx = project.indexers[ctx.path]
+        for fi in idx.funcs:
+            if not isinstance(fi.node, ast.AsyncFunctionDef):
+                continue
+            shared = _Shared(module_globals, _cells_of(fi.node))
+            label = _func_label(ctx, fi.label)
+            findings.extend(
+                _StaleGuardScan(ctx, fi.node, shared, index).run())
+            findings.extend(_check_iter_invalidate(
+                ctx, fi.node, shared, index, label))
+            findings.extend(_check_lock_hold(ctx, fi.node, locks))
+    return findings

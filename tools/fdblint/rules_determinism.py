@@ -65,7 +65,7 @@ def check(ctx: FileCtx) -> list[Finding]:
     if not _in_scope(ctx.path):
         return []
     out: list[Finding] = []
-    for node in ast.walk(ctx.tree):
+    for node in ctx.nodes():
         if not isinstance(node, ast.Call):
             continue
         raw = ctx.dotted(node.func)
@@ -260,14 +260,14 @@ _RECRUIT_SUFFIX = "cluster/recruitment.py"
 _RECRUIT_ANCHORS = ("select_workers", "select_replacement_hosts")
 
 
-def check_project(ctxs: list[FileCtx]) -> list[Finding]:
+def check_project(ctxs: list[FileCtx], project=None) -> list[Finding]:
     recruit_ctxs = [c for c in ctxs if c.path.endswith(_RECRUIT_SUFFIX)]
     if not recruit_ctxs:
         return []
     out: list[Finding] = []
     for ctx in recruit_ctxs:
         out.extend(_check_recruit_order(ctx))
-    out.extend(_check_recruit_reach(ctxs, recruit_ctxs))
+    out.extend(_check_recruit_reach(ctxs, recruit_ctxs, project=project))
     return out
 
 
@@ -280,20 +280,20 @@ def _anchor_defs(ctx: FileCtx) -> list[tuple[str, ast.AST]]:
     return out
 
 
-def _check_recruit_reach(ctxs, recruit_ctxs) -> list[Finding]:
+def _check_recruit_reach(ctxs, recruit_ctxs, project=None) -> list[Finding]:
     from .rules_jax import _Project
 
     anchors = [(c, name, node) for c in recruit_ctxs
                for name, node in _anchor_defs(c)]
     if not anchors:
         return []  # no ranker defined: nothing to wire
-    project = _Project(ctxs)
-    roots = _sim_loop_roots(project)
+    if project is None:
+        project = _Project(ctxs)
+    roots, reachable = sim_reachability(project)
     if not roots:
         # No simulator entry in the linted set (single-file invocations,
         # fixtures without a harness): reachability is unjudgeable.
         return []
-    reachable = _reachable(project, roots)
     out: list[Finding] = []
     for ctx, name, node in anchors:
         hit = any(fi.name == name
@@ -307,6 +307,18 @@ def _check_recruit_reach(ctxs, recruit_ctxs) -> list[Finding]:
                 "the shared recruitment ranker (tiers can diverge)",
                 end_line=node.lineno))
     return out
+
+
+def sim_reachability(project) -> tuple[list, set]:
+    """(sim_loop roots, reachable FuncInfo closure), computed ONCE per
+    shared project and memoized on it — both this pack and the knob pack
+    need the same walk."""
+    cached = getattr(project, "_sim_reachability", None)
+    if cached is None:
+        roots = _sim_loop_roots(project)
+        cached = (roots, _reachable(project, roots) if roots else set())
+        project._sim_reachability = cached
+    return cached
 
 
 def _sim_loop_roots(project) -> list:
@@ -339,7 +351,7 @@ def _class_index(project) -> dict:
     index: dict = {}
     for ctx in project.ctxs:
         idx = project.indexers[ctx.path]
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes():
             if not isinstance(node, ast.ClassDef):
                 continue
             methods = [idx.by_node[n] for n in ast.walk(node)
@@ -419,7 +431,7 @@ def _check_recruit_order(ctx: FileCtx) -> list[Finding]:
     explicit key (make it total — end it with a unique id); next(iter())
     is a first-by-container-order pick."""
     out: list[Finding] = []
-    for node in ast.walk(ctx.tree):
+    for node in ctx.nodes():
         if not isinstance(node, ast.Call):
             continue
         fn = node.func

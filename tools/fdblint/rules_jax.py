@@ -148,7 +148,7 @@ class _Project:
     def _imports_of(self, ctx: FileCtx) -> dict[str, tuple[str, str]]:
         out: dict[str, tuple[str, str]] = {}
         parts = ctx.module.split(".") if ctx.module else []
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes():
             if not isinstance(node, ast.ImportFrom):
                 continue
             if node.level > 0:
@@ -240,7 +240,7 @@ class _TaintEngine:
     def seed_roots(self) -> None:
         for ctx in self.project.ctxs:
             idx = self.project.indexers[ctx.path]
-            for node in ast.walk(ctx.tree):
+            for node in ctx.nodes():
                 if not isinstance(node, ast.Call):
                     continue
                 resolved = ctx.resolve(node.func)
@@ -662,7 +662,7 @@ def _pipeline_scan(ctx: FileCtx) -> list[Finding]:
                 return True
         return False
 
-    for fn in ast.walk(ctx.tree):
+    for fn in ctx.nodes():
         if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
             continue
         if fn.name in _PIPELINE_SINKS:
@@ -710,8 +710,10 @@ def check(ctx: FileCtx) -> list[Finding]:
     return _pipeline_scan(ctx)  # the three taint rules need the project index
 
 
-def check_project(ctxs: list[FileCtx]) -> list[Finding]:
-    project = _Project(list(ctxs))
+def check_project(ctxs: list[FileCtx],
+                  project: Optional[_Project] = None) -> list[Finding]:
+    if project is None:
+        project = _Project(list(ctxs))
     engine = _TaintEngine(project)
     engine.run()
     donation = _DonationScan(project)

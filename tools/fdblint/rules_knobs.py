@@ -17,7 +17,15 @@ layers whole-tree:
 * every declared knob is referenced somewhere — attribute access,
   randomization entry, or any string literal naming it (``set_knob`` /
   ``--knob_x`` style); otherwise knob-dead, reported at the declare
-  site.
+  site;
+* every knob READ on a sim-reachable path (any function reachable from
+  a sim_loop root through the shared call-graph index) is randomized
+  somewhere — a draw-table entry (sim/config.py) or a
+  ``sim_random_range=`` kwarg at its ``init`` — or the swarm never
+  explores its space (knob-unrandomized, reported at the declare
+  site).  Genuinely fixed knobs — protocol constants, struct sizes,
+  client API limits — carry a baseline budget instead of per-line
+  pragmas: see tools/fdblint/baseline.json.
 """
 
 from __future__ import annotations
@@ -32,13 +40,13 @@ _REGISTRY_GLOBALS = {
     "CLIENT_KNOBS": "client",
 }
 
-
-def _declarations(ctxs: list[FileCtx]) -> dict[str, dict[str, int]]:
-    """registry ('server'/'client') -> {knob name: declare lineno}, from
-    any ``class *Knobs`` whose methods call ``init("NAME", ...)``."""
-    decls: dict[str, dict[str, int]] = {"server": {}, "client": {}}
+def _declarations(ctxs: list[FileCtx]) -> dict[str, dict[str, tuple[int, bool]]]:
+    """registry ('server'/'client') -> {knob: (declare lineno, has a
+    ``sim_random_range=`` kwarg)}, from any ``class *Knobs`` whose
+    methods call ``init("NAME", ...)``."""
+    decls: dict[str, dict[str, tuple[int, bool]]] = {"server": {}, "client": {}}
     for ctx in ctxs:
-        for cls in ast.walk(ctx.tree):
+        for cls in ctx.nodes():
             if not (isinstance(cls, ast.ClassDef) and cls.name.endswith("Knobs")):
                 continue
             reg = ("server" if cls.name.startswith("Server")
@@ -53,14 +61,19 @@ def _declarations(ctxs: list[FileCtx]) -> dict[str, dict[str, int]]:
                         and node.args
                         and isinstance(node.args[0], ast.Constant)
                         and isinstance(node.args[0].value, str)):
-                    decls[reg][node.args[0].value] = node.lineno
+                    ranged = any(
+                        kw.arg == "sim_random_range"
+                        and not (isinstance(kw.value, ast.Constant)
+                                 and kw.value.value is None)
+                        for kw in node.keywords)
+                    decls[reg][node.args[0].value] = (node.lineno, ranged)
     return decls
 
 
 def _attr_refs(ctx: FileCtx) -> list[tuple[str, str, ast.Attribute]]:
     """(registry, knob, node) for every SERVER_KNOBS.X-style access."""
     out = []
-    for node in ast.walk(ctx.tree):
+    for node in ctx.nodes():
         if (isinstance(node, ast.Attribute)
                 and isinstance(node.value, ast.Name)
                 and node.value.id in _REGISTRY_GLOBALS
@@ -90,18 +103,21 @@ def _randomization_entries(ctx: FileCtx) -> list[tuple[str, str, int]]:
     return out
 
 
-def check_project(ctxs: list[FileCtx]) -> list[Finding]:
+def check_project(ctxs: list[FileCtx], project=None) -> list[Finding]:
     decls = _declarations(ctxs)
     if not decls["server"] and not decls["client"]:
         return []  # knobs.py not in the scanned set: nothing to check
     decl_files = {c.path for c in ctxs
                   if any(isinstance(n, ast.ClassDef) and n.name.endswith("Knobs")
-                         for n in ast.walk(c.tree))}
+                         for n in c.nodes())}
     findings: list[Finding] = []
     referenced: dict[str, set[str]] = {"server": set(), "client": set()}
+    randomized: set[tuple[str, str]] = set()
 
+    all_refs: list[tuple[str, str, FileCtx, ast.Attribute]] = []
     for ctx in ctxs:
         for reg, knob, node in _attr_refs(ctx):
+            all_refs.append((reg, knob, ctx, node))
             referenced[reg].add(knob)
             if knob not in decls[reg]:
                 findings.append(Finding(
@@ -112,27 +128,33 @@ def check_project(ctxs: list[FileCtx]) -> list[Finding]:
                     end_line=node.end_lineno or node.lineno))
         for reg, knob, lineno in _randomization_entries(ctx):
             referenced[reg].add(knob)
+            randomized.add((reg, knob))
             if knob not in decls[reg]:
                 findings.append(Finding(
                     ctx.path, lineno, "knob-undeclared",
                     f"randomization entry ({knob!r}, {reg!r}) names an "
                     "undeclared knob — set_knob would raise mid-sim"))
 
-    # string references (set_knob("X"), "server:X" spec knobs, --knob_x)
+    # string references (set_knob("X"), "server:X" spec knobs, --knob_x):
+    # ONE compiled alternation over all declared names per constant,
+    # instead of a per-knob substring loop (the old scan was the single
+    # hottest per-file cost in a tree-wide run).
     all_knobs = {k for reg in decls.values() for k in reg}
     string_refs: set[str] = set()
-    for ctx in ctxs:
-        if ctx.path in decl_files:
-            continue
-        for node in ast.walk(ctx.tree):
-            if isinstance(node, ast.Constant) and isinstance(node.value, str):
-                up = node.value.upper()
-                for k in all_knobs:
-                    if k in up and re.search(rf"\b{re.escape(k)}\b", up):
-                        string_refs.add(k)
+    if all_knobs:
+        pat = re.compile(
+            r"\b(?:" + "|".join(sorted(map(re.escape, all_knobs))) + r")\b")
+        for ctx in ctxs:
+            if ctx.path in decl_files:
+                continue
+            for node in ctx.nodes():
+                if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                    for m in pat.finditer(node.value.upper()):
+                        string_refs.add(m.group(0))
 
     for reg in ("server", "client"):
-        for knob, lineno in sorted(decls[reg].items(), key=lambda kv: kv[1]):
+        for knob, (lineno, _) in sorted(decls[reg].items(),
+                                        key=lambda kv: kv[1][0]):
             if knob in referenced[reg] or knob in string_refs:
                 continue
             path = next(iter(
@@ -145,7 +167,88 @@ def check_project(ctxs: list[FileCtx]) -> list[Finding]:
                 f"knob {knob} is declared but referenced nowhere (no "
                 "attribute access, randomization entry, or string "
                 "reference) — remove it or wire it up"))
+
+    findings.extend(_check_unrandomized(
+        ctxs, decls, decl_files, randomized, project, all_refs))
     return findings
+
+
+def _check_unrandomized(ctxs: list[FileCtx], decls, decl_files: set[str],
+                        randomized: set[tuple[str, str]],
+                        project, all_refs) -> list[Finding]:
+    """Declared knob read on a sim-reachable path but absent from every
+    randomization draw table: the swarm pins it at its default forever,
+    so its whole configuration space is untested."""
+    if not randomized:
+        return []  # no draw tables in the linted set: unjudgeable
+    from .rules_determinism import sim_reachability
+    from .rules_jax import _Project
+
+    if project is None:
+        project = _Project(list(ctxs))
+    roots, reachable = sim_reachability(project)
+    if not roots:
+        return []
+
+    def fi_reachable(fi) -> bool:
+        while fi is not None:
+            if fi in reachable:
+                return True
+            fi = fi.parent
+        return False
+
+    # Innermost enclosing function per read site, found by line span
+    # over the shared index (no re-walk of any tree): the smallest
+    # FuncInfo span containing the ref's line wins.
+    spans: dict[str, list[tuple[int, int, object]]] = {}
+
+    def innermost(path: str, lineno: int):
+        if path not in spans:
+            spans[path] = sorted(
+                (fi.node.lineno, fi.node.end_lineno or fi.node.lineno, fi)
+                for fi in project.indexers[path].funcs)
+        best = None
+        for start, end, fi in spans[path]:
+            if start > lineno:
+                break
+            if end >= lineno:
+                best = fi  # later == larger start == more deeply nested
+        return best
+
+    # first sim-reachable read site per (registry, knob)
+    read_at: dict[tuple[str, str], tuple[str, int]] = {}
+    for reg, knob, ctx, node in all_refs:
+        if ctx.path in decl_files:
+            continue
+        key = (reg, knob)
+        if key in read_at:
+            continue
+        fi = innermost(ctx.path, node.lineno)
+        if fi is None or fi_reachable(fi):
+            read_at[key] = (ctx.path, node.lineno)
+
+    out: list[Finding] = []
+    for reg in ("server", "client"):
+        for knob, (lineno, ranged) in sorted(decls[reg].items(),
+                                             key=lambda kv: kv[1][0]):
+            key = (reg, knob)
+            if ranged or key in randomized or key not in read_at:
+                continue
+            rpath, rline = read_at[key]
+            path = next(iter(
+                c.path for c in ctxs
+                if c.path in decl_files and knob in c.source), None)
+            if path is None:
+                continue
+            out.append(Finding(
+                path, lineno, "knob-unrandomized",
+                f"{('SERVER' if reg == 'server' else 'CLIENT')}_KNOBS."
+                f"{knob} is read on a sim-reachable path "
+                f"({rpath}:{rline}) but nothing randomizes it (no draw-"
+                "table entry, no sim_random_range=) — the swarm never "
+                "explores its space; add a draw or budget it in the "
+                "baseline as genuinely fixed"))
+    return out
 
 
 def check(ctx: FileCtx) -> list[Finding]:

@@ -52,7 +52,7 @@ def check(ctx: FileCtx) -> list[Finding]:
     if not ctx.path.startswith("foundationdb_tpu/"):
         return []
     findings: list[Finding] = []
-    for node in ast.walk(ctx.tree):
+    for node in ctx.nodes():
         if not isinstance(node, ast.Call) \
                 or not isinstance(node.func, ast.Attribute) \
                 or node.func.attr not in _REGISTER_METHODS:

@@ -1,0 +1,386 @@
+"""Rule pack 9 — wire-schema drift gate.
+
+The wire surface of the cluster is spread across four layers that can
+each drift silently: the ``register_message`` dataclass registry (the
+self-describing value codec encodes field NAMES, so a rename breaks
+decode on the other side of a mixed-version boundary), the WLTOKEN
+well-known-endpoint table (a renumber routes requests to the wrong
+actor), the columnar codec headers (magic / struct layout of
+WireBatch, CommitWireBatch, TaggedMutationBatch), and the native
+envelope's type-tag table which must mirror the Python oracle
+tag-for-tag.
+
+``schema_baseline.json`` is a checked-in snapshot of the first three
+surfaces plus PROTOCOL_VERSION.  The gate:
+
+* wire-schema-drift — a baselined message lost/renamed/retyped/
+  reordered a field, a WLTOKEN was renumbered or removed, or a codec
+  header's magic/layout changed, all WITHOUT a PROTOCOL_VERSION bump.
+  Additive changes (new message, appended field, new token) pass the
+  gate; the baseline↔tree sync test then forces a conscious
+  ``--regen-schema-baseline`` so the snapshot stays current.
+* native-grammar-sync — the ``constexpr uint8_t T_* = N`` table in
+  native/envelope.cpp (between the ``fdblint:tag-table`` comment
+  anchors) diverges from the ``_T_*`` tuple-assigns in
+  core/serialize.py.  This is a LIVE cross-check, not a baseline
+  diff: the two tables must match exactly, always.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from typing import Optional
+
+from .core import FileCtx, Finding
+
+BASELINE_NAME = "schema_baseline.json"
+
+# codec-header constant shapes: _MAGIC / _TMB_MAGIC, _VERSION / _TMB_VERSION,
+# _HEADER / _TMB_HEADER.  The optional middle group names the codec within
+# the file ("" = the file's primary codec).
+_CODEC_RE = re.compile(r"^_(?:([A-Z0-9]+)_)?(MAGIC|VERSION|HEADER)$")
+
+_CPP_TAG_RE = re.compile(r"\b(T_[A-Z0-9_]+)\s*=\s*(\d+)")
+_CPP_ANCHOR = "fdblint:tag-table"
+
+
+# -- live extraction ----------------------------------------------------
+
+
+def _registered_names(ctxs: list[FileCtx]) -> set[str]:
+    """Class names passed to register_message: decorator form, direct
+    ``register_message(Cls)`` calls, and the registration-loop idiom
+    ``for cls in (A, B, ...): register_message(cls)``."""
+    names: set[str] = set()
+    for ctx in ctxs:
+        loop_targets: dict[str, ast.AST] = {}
+        for node in ctx.nodes():
+            if isinstance(node, ast.For) and isinstance(node.target, ast.Name):
+                loop_targets[node.target.id] = node.iter
+            elif isinstance(node, ast.ClassDef):
+                for dec in node.decorator_list:
+                    d = dec.func if isinstance(dec, ast.Call) else dec
+                    if (isinstance(d, (ast.Name, ast.Attribute))
+                            and (d.id if isinstance(d, ast.Name) else d.attr)
+                            == "register_message"):
+                        names.add(node.name)
+        for node in ctx.nodes():
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, (ast.Name, ast.Attribute))
+                    and (node.func.id if isinstance(node.func, ast.Name)
+                         else node.func.attr) == "register_message"
+                    and node.args and isinstance(node.args[0], ast.Name)):
+                continue
+            arg = node.args[0].id
+            it = loop_targets.get(arg)
+            if it is None:
+                names.add(arg)
+            elif isinstance(it, (ast.Tuple, ast.List)):
+                names.update(el.id for el in it.elts
+                             if isinstance(el, ast.Name))
+    return names
+
+
+def _message_fields(ctxs: list[FileCtx], registered: set[str]):
+    """name -> ([(field, type), ...] in declaration order, path, line)."""
+    out: dict[str, tuple[list[list[str]], str, int]] = {}
+    for ctx in ctxs:
+        for node in ctx.nodes():
+            if not (isinstance(node, ast.ClassDef)
+                    and node.name in registered
+                    and node.name not in out):
+                continue
+            fields = [
+                [stmt.target.id, ast.unparse(stmt.annotation)]
+                for stmt in node.body
+                if isinstance(stmt, ast.AnnAssign)
+                and isinstance(stmt.target, ast.Name)
+            ]
+            out[node.name] = (fields, ctx.path, node.lineno)
+    return out
+
+
+def _wltokens(ctxs: list[FileCtx]):
+    """WLTOKEN_X -> (value, path, line) from module-level int assigns."""
+    out: dict[str, tuple[int, str, int]] = {}
+    for ctx in ctxs:
+        for node in ctx.tree.body:
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id.startswith("WLTOKEN_")
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, int)):
+                out[node.targets[0].id] = (
+                    node.value.value, ctx.path, node.lineno)
+    return out
+
+
+def _codec_headers(ctxs: list[FileCtx]):
+    """'path::PREFIX' -> ({'magic','version','header'}, path, line-of-magic).
+    Only codecs that declare a MAGIC count (a bare _VERSION constant in
+    some unrelated module is not a wire codec)."""
+    raw: dict[tuple[str, str], dict] = {}
+    lines: dict[tuple[str, str], int] = {}
+    for ctx in ctxs:
+        for node in ctx.tree.body:
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                continue
+            m = _CODEC_RE.match(node.targets[0].id)
+            if m is None:
+                continue
+            prefix, kind = m.group(1) or "", m.group(2)
+            key = (ctx.path, prefix)
+            v = node.value
+            if kind in ("MAGIC", "VERSION"):
+                if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                    raw.setdefault(key, {})[kind.lower()] = (
+                        f"0x{v.value:X}" if kind == "MAGIC" else v.value)
+                    if kind == "MAGIC":
+                        lines[key] = node.lineno
+            elif kind == "HEADER":
+                fmt = None
+                if (isinstance(v, ast.Call) and v.args
+                        and isinstance(v.args[0], ast.Constant)
+                        and isinstance(v.args[0].value, str)):
+                    fmt = v.args[0].value
+                elif isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    fmt = v.value
+                if fmt is not None:
+                    raw.setdefault(key, {})["header"] = fmt
+    return {
+        f"{path}::{prefix}": (entry, path, lines.get((path, prefix), 1))
+        for (path, prefix), entry in raw.items()
+        if "magic" in entry
+    }
+
+
+def _protocol_version(ctxs: list[FileCtx]) -> Optional[tuple[str, str, int]]:
+    for ctx in ctxs:
+        if not ctx.path.endswith("core/serialize.py"):
+            continue
+        for node in ctx.tree.body:
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == "PROTOCOL_VERSION"
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, int)):
+                return f"0x{node.value.value:X}", ctx.path, node.lineno
+    return None
+
+
+def extract_schema(ctxs: list[FileCtx]):
+    """(baseline-shaped dict, location index) from the live tree, or
+    (None, None) when core/serialize.py is not in the linted set (a
+    partial lint cannot judge the wire surface)."""
+    pv = _protocol_version(ctxs)
+    if pv is None:
+        return None, None
+    registered = _registered_names(ctxs)
+    messages = _message_fields(ctxs, registered)
+    tokens = _wltokens(ctxs)
+    codecs = _codec_headers(ctxs)
+    schema = {
+        "protocol_version": pv[0],
+        "messages": {n: fields for n, (fields, _, _) in sorted(messages.items())},
+        "wltokens": {n: v for n, (v, _, _) in sorted(tokens.items())},
+        "codecs": {k: entry for k, (entry, _, _) in sorted(codecs.items())},
+    }
+    index = {
+        "protocol_version": (pv[1], pv[2]),
+        "messages": {n: (p, ln) for n, (_, p, ln) in messages.items()},
+        "wltokens": {n: (p, ln) for n, (_, p, ln) in tokens.items()},
+        "codecs": {k: (p, ln) for k, (_, p, ln) in codecs.items()},
+    }
+    return schema, index
+
+
+# -- drift diff ---------------------------------------------------------
+
+
+def diff_schema(baseline: dict, live: dict, index: dict) -> list[Finding]:
+    """wire-schema-drift findings for destructive divergence from the
+    baseline.  A PROTOCOL_VERSION bump waives the gate for that commit —
+    the sync test then forces a baseline regen."""
+    pv_path, pv_line = index["protocol_version"]
+    if live["protocol_version"] != baseline.get("protocol_version"):
+        return []  # version bumped: destructive change is declared
+
+    out: list[Finding] = []
+
+    def drift(path: str, line: int, msg: str) -> None:
+        out.append(Finding(path, line, "wire-schema-drift",
+                           msg + " — bump PROTOCOL_VERSION (and regen "
+                           f"{BASELINE_NAME}) if this break is intended"))
+
+    for name, base_fields in baseline.get("messages", {}).items():
+        live_fields = live["messages"].get(name)
+        if live_fields is None:
+            drift(pv_path, pv_line,
+                  f"wire message {name} was baselined but is no longer "
+                  "registered")
+            continue
+        path, line = index["messages"][name]
+        base_t = [tuple(f) for f in base_fields]
+        live_t = [tuple(f) for f in live_fields]
+        if live_t[:len(base_t)] != base_t:
+            for i, bf in enumerate(base_t):
+                lf = live_t[i] if i < len(live_t) else None
+                if lf != bf:
+                    was = f"{bf[0]}: {bf[1]}"
+                    now = f"{lf[0]}: {lf[1]}" if lf else "removed"
+                    drift(path, line,
+                          f"wire message {name} field #{i} changed "
+                          f"({was!r} -> {now!r}); baselined fields must "
+                          "stay a prefix of the declaration")
+                    break
+
+    for name, value in baseline.get("wltokens", {}).items():
+        if name not in live["wltokens"]:
+            drift(pv_path, pv_line,
+                  f"{name} was baselined but is gone — stale peers still "
+                  "route to it")
+        elif live["wltokens"][name] != value:
+            path, line = index["wltokens"][name]
+            drift(path, line,
+                  f"{name} renumbered {value} -> {live['wltokens'][name]}; "
+                  "requests from unupgraded peers land on the wrong actor")
+
+    for key, base_entry in baseline.get("codecs", {}).items():
+        live_entry = live["codecs"].get(key)
+        if live_entry is None:
+            drift(pv_path, pv_line,
+                  f"columnar codec {key} was baselined but is gone")
+            continue
+        path, line = index["codecs"][key]
+        if live_entry.get("version") != base_entry.get("version"):
+            continue  # codec-local version bump declares its own break
+        for k in ("magic", "header"):
+            if live_entry.get(k) != base_entry.get(k):
+                drift(path, line,
+                      f"columnar codec {key} {k} changed "
+                      f"({base_entry.get(k)} -> {live_entry.get(k)}) with "
+                      "no codec version bump")
+    return out
+
+
+# -- native tag-table sync ---------------------------------------------
+
+
+def _py_tag_table(ctxs: list[FileCtx]) -> dict[str, int]:
+    """T_NAME -> value from the ``_T_A, _T_B = 0, 1`` tuple-assigns (and
+    any single assigns) in core/serialize.py."""
+    tags: dict[str, int] = {}
+    for ctx in ctxs:
+        if not ctx.path.endswith("core/serialize.py"):
+            continue
+        for node in ctx.tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            for tgt in node.targets:
+                names = (tgt.elts if isinstance(tgt, ast.Tuple) else [tgt])
+                vals = (node.value.elts if isinstance(node.value, ast.Tuple)
+                        else [node.value])
+                if len(names) != len(vals):
+                    continue
+                for n, v in zip(names, vals):
+                    if (isinstance(n, ast.Name) and n.id.startswith("_T_")
+                            and isinstance(v, ast.Constant)
+                            and isinstance(v.value, int)):
+                        tags[n.id[1:]] = v.value
+    return tags
+
+
+def check_native_sync(root: str, ctxs: list[FileCtx]) -> list[Finding]:
+    cpp = os.path.join(root, "native", "envelope.cpp")
+    if not os.path.exists(cpp):
+        return []
+    py_tags = _py_tag_table(ctxs)
+    if not py_tags:
+        return []
+    rel = os.path.relpath(cpp, root).replace(os.sep, "/")
+    with open(cpp, "r", encoding="utf-8") as f:
+        lines = f.read().splitlines()
+
+    anchored: list[tuple[int, str]] = []
+    inside = False
+    for i, line in enumerate(lines, 1):
+        if _CPP_ANCHOR in line:
+            inside = not inside
+            continue
+        if inside:
+            anchored.append((i, line))
+    if not anchored:
+        return [Finding(rel, 1, "native-grammar-sync",
+                        f"no '// {_CPP_ANCHOR}' comment anchors around the "
+                        "type-tag table — the sync gate cannot locate it")]
+
+    cpp_tags: dict[str, tuple[int, int]] = {}
+    for i, line in anchored:
+        for m in _CPP_TAG_RE.finditer(line):
+            cpp_tags[m.group(1)] = (int(m.group(2)), i)
+
+    out: list[Finding] = []
+    first_line = anchored[0][0]
+    for name, value in sorted(py_tags.items(), key=lambda kv: kv[1]):
+        if name not in cpp_tags:
+            out.append(Finding(rel, first_line, "native-grammar-sync",
+                               f"Python oracle defines _{name} = {value} but "
+                               "the native tag table has no such tag — native "
+                               "decode will reject frames the oracle emits"))
+        elif cpp_tags[name][0] != value:
+            cv, ln = cpp_tags[name]
+            out.append(Finding(rel, ln, "native-grammar-sync",
+                               f"{name} = {cv} in the native table but "
+                               f"{value} in core/serialize.py — the two "
+                               "codecs disagree on the grammar"))
+    for name, (cv, ln) in sorted(cpp_tags.items(), key=lambda kv: kv[1][0]):
+        if name not in py_tags:
+            out.append(Finding(rel, ln, "native-grammar-sync",
+                               f"native tag {name} = {cv} has no _{name} "
+                               "in core/serialize.py"))
+    return out
+
+
+# -- entry points -------------------------------------------------------
+
+
+def baseline_path(root: str) -> str:
+    return os.path.join(root, "tools", "fdblint", BASELINE_NAME)
+
+
+def regen_baseline(root: str, ctxs: list[FileCtx]) -> str:
+    schema, _ = extract_schema(ctxs)
+    if schema is None:
+        raise RuntimeError(
+            "core/serialize.py not in the linted set; cannot extract the "
+            "wire schema")
+    path = baseline_path(root)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(schema, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def check_root(root: str, ctxs: list[FileCtx]) -> list[Finding]:
+    live, index = extract_schema(ctxs)
+    if live is None:
+        return []  # partial lint: wire surface out of scope
+    findings: list[Finding] = []
+    bp = baseline_path(root)
+    if not os.path.exists(bp):
+        pv_path, pv_line = index["protocol_version"]
+        findings.append(Finding(
+            pv_path, pv_line, "wire-schema-drift",
+            f"tools/fdblint/{BASELINE_NAME} is missing — run "
+            "'python -m tools.fdblint --regen-schema-baseline .' and check "
+            "it in"))
+    else:
+        with open(bp, "r", encoding="utf-8") as f:
+            baseline = json.load(f)
+        findings.extend(diff_schema(baseline, live, index))
+    findings.extend(check_native_sync(root, ctxs))
+    return findings

@@ -50,7 +50,7 @@ def check(ctx: FileCtx) -> list[Finding]:
     if not ctx.path.startswith("foundationdb_tpu/"):
         return []
     findings: list[Finding] = []
-    for node in ast.walk(ctx.tree):
+    for node in ctx.nodes():
         if not isinstance(node, ast.Expr):
             continue
         value = node.value

@@ -53,7 +53,7 @@ def check(ctx: FileCtx) -> list[Finding]:
     if ctx.path == "foundationdb_tpu/core/serialize.py":
         return []  # the negotiated path itself
     findings: list[Finding] = []
-    for node in ast.walk(ctx.tree):
+    for node in ctx.nodes():
         if not (isinstance(node, ast.Call)
                 and isinstance(node.func, ast.Attribute)
                 and node.func.attr in _WRITE_METHODS):

@@ -36,6 +36,16 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _gate_signature() -> str:
+    """Static-gate stamp for repro blocks: which fdblint generation the
+    tree passed when this failure was found (tools/fdblint)."""
+    try:
+        from tools.fdblint import gate_signature
+        return gate_signature()
+    except Exception:  # noqa: BLE001 — a sweep must not die on lint tooling
+        return "fdblint unavailable"
+
+
 def regions_spec(seed: int) -> dict:
     """Per-seed variation of the two-region chaos base: randomized k-way
     log replication, conflict-set backend, and the push-retry / router
@@ -280,6 +290,9 @@ def main() -> int:
                 line += "\n  sev-error event: " + json.dumps(
                     e, sort_keys=True, default=str
                 )
+            # gate line BEFORE the spec: the spec stays the line's tail
+            # so `split("repro spec: ")[1]` is pure JSON for replays.
+            line += "\n  static gate: " + _gate_signature()
             line += "\n  repro spec: " + json.dumps(spec, sort_keys=True,
                                                     default=str)
         print(line, flush=True)

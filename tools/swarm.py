@@ -51,6 +51,16 @@ EXIT_CAP = 125  # os.exit truncates to a byte; 125 keeps 126/127/128+n
 _FORCE_KNOBS = 3  # least-covered knob buckets force-drawn per guided seed
 
 
+def _gate_signature() -> str:
+    """Static-gate stamp for repro blocks: which fdblint generation the
+    tree passed when this failure was found (tools/fdblint)."""
+    try:
+        from tools.fdblint import gate_signature
+        return gate_signature()
+    except Exception:  # noqa: BLE001 — a sweep must not die on lint tooling
+        return "fdblint unavailable"
+
+
 def _pool_init():
     """Worker bootstrap (spawn context): repo imports + CPU-pinned JAX
     (a worker drawing CONFLICT_SET_IMPL=tpu must not fight for a device
@@ -215,6 +225,10 @@ def run_swarm(budget: int, jobs: int, seed_base: int = 0,
                     for e in rec.get("sev_error_events", [])[:5]:
                         line += "\n  sev-error event: " + json.dumps(
                             e, sort_keys=True, default=str)
+                    # gate line BEFORE the spec: the spec stays the
+                    # line's tail so `split("repro spec: ")[1]` is pure
+                    # JSON (the replay tooling and tests parse it).
+                    line += "\n  static gate: " + _gate_signature()
                     line += "\n  repro spec: " + json.dumps(
                         rec["spec"], sort_keys=True, default=str)
                 log(line)
